@@ -1,0 +1,50 @@
+"""Headline benchmark driver.
+
+Runs the reference's PPO wall-clock recipe (CartPole-v1, 65_536 policy steps,
+rollout 128, 4 envs, logging/ckpt/test off — reference
+configs/exp/ppo_benchmarks.yaml, measured at 81.27 s on 4 CPUs ⇒ ~806 SPS,
+BASELINE.md) and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+`vs_baseline` is our steps-per-second over the reference's published SPS.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+BASELINE_SECONDS = 81.27  # reference README.md:97-112 (v0.5.5, 4 CPU)
+TOTAL_STEPS = 65_536
+
+
+def main() -> None:
+    from sheeprl_tpu.cli import run
+
+    t0 = time.perf_counter()
+    run(
+        [
+            "exp=ppo_benchmarks",
+            f"algo.total_steps={TOTAL_STEPS}",
+        ]
+    )
+    elapsed = time.perf_counter() - t0
+    sps = TOTAL_STEPS / elapsed
+    baseline_sps = TOTAL_STEPS / BASELINE_SECONDS
+    print(
+        json.dumps(
+            {
+                "metric": "PPO CartPole-v1 65536-step policy SPS (reference recipe)",
+                "value": round(sps, 2),
+                "unit": "env steps/sec",
+                "vs_baseline": round(sps / baseline_sps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""SheepRL-TPU — a TPU-native deep-RL framework.
+
+A ground-up JAX/XLA re-design with the capabilities of SheepRL (the reference
+torch/Lightning framework): registry-dispatched algorithms, Hydra-style
+config composition, host-side numpy replay buffers streaming to HBM, and
+jitted SPMD train steps over a `jax.sharding.Mesh` in place of DDP.
+
+Importing this package populates the algorithm/evaluation registries
+(reference sheeprl/__init__.py:19-49 imports every algo module for the same
+reason).
+"""
+from __future__ import annotations
+
+import os
+
+__version__ = "0.1.0"
+
+# Algorithm modules register themselves on import.
+from sheeprl_tpu.algos import ppo  # noqa: F401,E402
+
+__all__ = ["__version__"]

@@ -1,0 +1,1 @@
+from . import ppo  # noqa: F401 — registers the algorithm + evaluation

@@ -1,0 +1,184 @@
+"""PPO actor-critic (Flax).
+
+Counterpart of reference sheeprl/algos/ppo/agent.py (298 LoC): a
+`MultiEncoder` (NatureCNN for pixel keys + MLP for vector keys,
+reference ppo/agent.py:30-90), an actor trunk with one categorical head per
+discrete action dim or Gaussian mean/log_std heads for continuous spaces
+(:92-180), and an MLP critic (:182-220).
+
+No player/trainer module duality (reference :254-298 ties weights between a
+DDP module and a single-device copy): here the same pure `apply` serves
+rollout and training with whatever params pytree you hand it.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models import MLP, NatureCNN
+from ...distributions import Categorical, Normal, Independent
+
+
+class PPOEncoder(nn.Module):
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_features_dim: int = 512
+    mlp_features_dim: int = 64
+    dense_units: int = 64
+    mlp_layers: int = 2
+    dense_act: str = "tanh"
+    layer_norm: bool = False
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        feats: List[jax.Array] = []
+        if self.cnn_keys:
+            img = jnp.concatenate([obs[k] for k in self.cnn_keys], axis=-1)
+            feats.append(NatureCNN(features_dim=self.cnn_features_dim)(img))
+        if self.mlp_keys:
+            vec = jnp.concatenate([obs[k].astype(jnp.float32) for k in self.mlp_keys], axis=-1)
+            feats.append(
+                MLP(
+                    hidden_sizes=(self.dense_units,) * self.mlp_layers,
+                    # reference MLPEncoder projects to features_dim (agent.py:38-55)
+                    output_dim=self.mlp_features_dim or None,
+                    activation=self.dense_act,
+                    norm_layer="layernorm" if self.layer_norm else None,
+                )(vec)
+            )
+        return jnp.concatenate(feats, axis=-1)
+
+
+class PPOAgent(nn.Module):
+    """Returns (actor_out, value). `actor_out` is a list of per-dim logits for
+    (multi)discrete spaces or [mean, log_std] for continuous ones."""
+
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    cnn_keys: Sequence[str] = ()
+    mlp_keys: Sequence[str] = ()
+    cnn_features_dim: int = 512
+    mlp_features_dim: int = 64
+    dense_units: int = 64
+    mlp_layers: int = 2
+    dense_act: str = "tanh"
+    layer_norm: bool = False
+
+    def setup(self) -> None:
+        self.encoder = PPOEncoder(
+            cnn_keys=self.cnn_keys,
+            mlp_keys=self.mlp_keys,
+            cnn_features_dim=self.cnn_features_dim,
+            mlp_features_dim=self.mlp_features_dim,
+            dense_units=self.dense_units,
+            mlp_layers=self.mlp_layers,
+            dense_act=self.dense_act,
+            layer_norm=self.layer_norm,
+        )
+        trunk = dict(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation=self.dense_act,
+            norm_layer="layernorm" if self.layer_norm else None,
+        )
+        self.actor_backbone = MLP(**trunk)
+        self.critic = MLP(output_dim=1, **trunk)
+        if self.is_continuous:
+            self.fc_mean = nn.Dense(sum(self.actions_dim))
+            self.fc_logstd = nn.Dense(sum(self.actions_dim))
+        else:
+            self.actor_heads = [nn.Dense(d) for d in self.actions_dim]
+
+    def __call__(self, obs: Dict[str, jax.Array]) -> Tuple[List[jax.Array], jax.Array]:
+        feat = self.encoder(obs)
+        value = self.critic(feat)
+        actor_feat = self.actor_backbone(feat)
+        if self.is_continuous:
+            mean = self.fc_mean(actor_feat)
+            log_std = self.fc_logstd(actor_feat)
+            return [mean, log_std], value
+        return [head(actor_feat) for head in self.actor_heads], value
+
+
+def actions_and_log_probs(
+    actor_out: List[jax.Array],
+    is_continuous: bool,
+    key: Optional[jax.Array] = None,
+    actions: Optional[jax.Array] = None,
+    greedy: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared sample/evaluate path: returns (actions, log_prob, entropy).
+
+    With `actions` given, evaluates their log-prob (train path, reference
+    ppo/agent.py forward with actions); otherwise samples (rollout path).
+    Discrete actions are stored as one int column per action dim.
+    """
+    if is_continuous:
+        mean, log_std = actor_out
+        dist = Independent(Normal(mean, jnp.exp(log_std)), 1)
+        if actions is None:
+            actions = dist.mode if greedy else dist.rsample(key)
+        logprob = dist.log_prob(actions)
+        entropy = dist.entropy()
+        return actions, logprob[..., None], entropy[..., None]
+    logprobs = []
+    entropies = []
+    outs = []
+    n = len(actor_out)
+    keys = jax.random.split(key, n) if key is not None else [None] * n
+    for i, logits in enumerate(actor_out):
+        dist = Categorical(logits=logits)
+        if actions is None:
+            act = dist.mode if greedy else dist.sample(keys[i])
+        else:
+            act = actions[..., i]
+        outs.append(act)
+        logprobs.append(dist.log_prob(act))
+        entropies.append(dist.entropy())
+    acts = jnp.stack(outs, axis=-1).astype(jnp.int32)
+    logprob = sum(logprobs)[..., None]
+    entropy = sum(entropies)[..., None]
+    return acts, logprob, entropy
+
+
+def build_agent(
+    dist: Any,
+    cfg: Any,
+    observation_space: gym.spaces.Dict,
+    action_space: gym.Space,
+    key: jax.Array,
+    params: Optional[Any] = None,
+) -> Tuple[PPOAgent, Any]:
+    """Construct module + params (reference ppo/agent.py:254-298 build_agent)."""
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    if is_continuous:
+        actions_dim = [int(np.prod(action_space.shape))]
+    elif isinstance(action_space, gym.spaces.MultiDiscrete):
+        actions_dim = [int(n) for n in action_space.nvec]
+    else:
+        actions_dim = [int(action_space.n)]
+    enc = cfg.algo.encoder
+    module = PPOAgent(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        cnn_keys=tuple(cfg.algo.cnn_keys.encoder),
+        mlp_keys=tuple(cfg.algo.mlp_keys.encoder),
+        cnn_features_dim=enc.cnn_features_dim,
+        mlp_features_dim=enc.mlp_features_dim,
+        dense_units=cfg.algo.dense_units,
+        mlp_layers=cfg.algo.mlp_layers,
+        dense_act=cfg.algo.dense_act,
+        layer_norm=cfg.algo.layer_norm,
+    )
+    if params is None:
+        dummy_obs = {}
+        for k in list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder):
+            shape = observation_space[k].shape
+            dummy_obs[k] = jnp.zeros((1,) + tuple(shape), dtype=jnp.float32)
+        params = module.init(key, dummy_obs)["params"]
+    params = dist.replicate(params)
+    return module, params

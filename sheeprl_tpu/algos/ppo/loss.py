@@ -1,0 +1,45 @@
+"""PPO losses (reference sheeprl/algos/ppo/loss.py).
+
+`policy_loss`: clipped surrogate; `value_loss`: MSE with optional clipping;
+entropy bonus handled in the combined objective. All math in f32.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def policy_loss(
+    logprobs: jax.Array,
+    old_logprobs: jax.Array,
+    advantages: jax.Array,
+    clip_coef: jax.Array,
+    reduction: str = "mean",
+) -> jax.Array:
+    log_ratio = logprobs - old_logprobs
+    ratio = jnp.exp(log_ratio)
+    pg1 = -advantages * ratio
+    pg2 = -advantages * jnp.clip(ratio, 1.0 - clip_coef, 1.0 + clip_coef)
+    loss = jnp.maximum(pg1, pg2)
+    return jnp.mean(loss) if reduction == "mean" else jnp.sum(loss)
+
+
+def value_loss(
+    new_values: jax.Array,
+    old_values: jax.Array,
+    returns: jax.Array,
+    clip_coef: jax.Array,
+    clip_vloss: bool,
+    reduction: str = "mean",
+) -> jax.Array:
+    if clip_vloss:
+        v_clipped = old_values + jnp.clip(new_values - old_values, -clip_coef, clip_coef)
+        loss = jnp.maximum(jnp.square(new_values - returns), jnp.square(v_clipped - returns))
+        loss = 0.5 * loss
+    else:
+        loss = 0.5 * jnp.square(new_values - returns)
+    return jnp.mean(loss) if reduction == "mean" else jnp.sum(loss)
+
+
+def entropy_loss(entropy: jax.Array, reduction: str = "mean") -> jax.Array:
+    return -(jnp.mean(entropy) if reduction == "mean" else jnp.sum(entropy))

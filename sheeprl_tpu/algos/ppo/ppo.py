@@ -1,0 +1,359 @@
+"""PPO — coupled on-policy training (Template A).
+
+TPU-native re-design of reference sheeprl/algos/ppo/ppo.py (452 LoC):
+
+* rollout on host (CPU envs) with a single jitted `act` fn — the only
+  per-step device work (SURVEY.md §7 host↔device-boundary risk);
+* GAE as a reverse `lax.scan` on device (reference python loop utils.py:63);
+* the whole update phase — `update_epochs` × minibatches with in-jit
+  permutations — is ONE jitted, donated-argument XLA program
+  (reference ppo.py:52-102 dispatches one torch step per minibatch);
+* data parallelism: params replicated / batch sharded over the `dp` mesh
+  axis; XLA inserts the gradient all-reduce (replaces Fabric DDP,
+  reference ppo.py:93).
+* `buffer.share_data` (reference ppo.py:362-369 all_gather) is implicit:
+  the single JAX controller already sees every env's data.
+
+LR / clip / entropy annealing (reference ppo.py:414-424) is passed as traced
+scalars so annealing never retraces.
+"""
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ...config import Config, instantiate
+from ...data import ReplayBuffer
+from ...ops import gae as gae_op
+from ...optim import clipped
+from ...parallel import Distributed
+from ...utils.checkpoint import CheckpointManager
+from ...utils.env import episode_stats, vectorize
+from ...utils.logger import get_log_dir, get_logger
+from ...utils.metric import MetricAggregator
+from ...utils.registry import register_algorithm, register_evaluation
+from ...utils.timer import timer
+from ...utils.utils import Ratio, linear_annealing, save_configs
+from .agent import PPOAgent, actions_and_log_probs, build_agent
+from .loss import entropy_loss, policy_loss, value_loss
+from .utils import AGGREGATOR_KEYS, prepare_obs, test
+
+
+def make_act_fn(module: PPOAgent):
+    @jax.jit
+    def act(params, obs, key):
+        actor_out, value = module.apply({"params": params}, obs)
+        actions, logprob, _ = actions_and_log_probs(actor_out, module.is_continuous, key=key)
+        return actions, logprob, value
+
+    return act
+
+
+def make_value_fn(module: PPOAgent):
+    @jax.jit
+    def value_fn(params, obs):
+        _, value = module.apply({"params": params}, obs)
+        return value
+
+    return value_fn
+
+
+def make_update_fn(module: PPOAgent, tx, cfg: Config, num_minibatches: int, mb_size: int):
+    """The whole PPO update (epochs × minibatches) as one jitted program."""
+    update_epochs = int(cfg.algo.update_epochs)
+    clip_vloss = bool(cfg.algo.clip_vloss)
+    normalize_advantages = bool(cfg.algo.normalize_advantages)
+    reduction = str(cfg.algo.loss_reduction)
+
+    def loss_fn(params, mb: Dict[str, jax.Array], coefs: Dict[str, jax.Array]):
+        obs = {k[4:]: v for k, v in mb.items() if k.startswith("obs:")}
+        actor_out, new_values = module.apply({"params": params}, obs)
+        actions = mb["actions"]
+        if not module.is_continuous:
+            actions = actions.astype(jnp.int32)
+        _, new_logprobs, entropy = actions_and_log_probs(
+            actor_out, module.is_continuous, actions=actions
+        )
+        advantages = mb["advantages"]
+        if normalize_advantages:
+            advantages = (advantages - jnp.mean(advantages)) / (jnp.std(advantages) + 1e-8)
+        pg_loss = policy_loss(
+            new_logprobs, mb["logprobs"], advantages, coefs["clip_coef"], reduction
+        )
+        v_loss = value_loss(
+            new_values, mb["values"], mb["returns"], coefs["clip_coef"], clip_vloss, reduction
+        )
+        ent_loss = entropy_loss(entropy, reduction)
+        loss = pg_loss + coefs["vf_coef"] * v_loss + coefs["ent_coef"] * ent_loss
+        return loss, {"Loss/policy_loss": pg_loss, "Loss/value_loss": v_loss, "Loss/entropy_loss": ent_loss}
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def update(params, opt_state, data: Dict[str, jax.Array], coefs, key):
+        batch = next(iter(data.values())).shape[0]
+
+        def epoch_step(carry, _):
+            params, opt_state, key = carry
+            key, pk = jax.random.split(key)
+            perm = jax.random.permutation(pk, batch)
+            idxs = perm[: num_minibatches * mb_size].reshape(num_minibatches, mb_size)
+
+            def mb_step(carry2, idx):
+                params, opt_state = carry2
+                mb = jax.tree.map(lambda x: jnp.take(x, idx, axis=0), data)
+                (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, mb, coefs)
+                updates, new_opt_state = tx.update(grads, opt_state, params)
+                updates = jax.tree.map(lambda u: u * coefs["lr_frac"], updates)
+                params = optax.apply_updates(params, updates)
+                return (params, new_opt_state), aux
+
+            (params, opt_state), auxs = jax.lax.scan(mb_step, (params, opt_state), idxs)
+            return (params, opt_state, key), auxs
+
+        (params, opt_state, key), auxs = jax.lax.scan(
+            epoch_step, (params, opt_state, key), None, length=update_epochs
+        )
+        metrics = jax.tree.map(jnp.mean, auxs)
+        return params, opt_state, metrics
+
+    return update
+
+
+@register_algorithm(name="ppo")
+def main(dist: Distributed, cfg: Config) -> None:
+    root_key = dist.seed_everything(cfg.seed)
+    rank = dist.process_index
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
+    logger = get_logger(cfg, log_dir, rank)
+    if rank == 0:
+        save_configs(cfg, log_dir)
+
+    envs = vectorize(cfg, cfg.seed, rank, log_dir)
+    obs_space = envs.single_observation_space
+    action_space = envs.single_action_space
+    num_envs = int(cfg.env.num_envs)
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+    if not isinstance(obs_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {obs_space}")
+
+    # -- resume ------------------------------------------------------------
+    state = None
+    if cfg.checkpoint.resume_from:
+        state = CheckpointManager.load(cfg.checkpoint.resume_from)
+
+    root_key, init_key = jax.random.split(state["rng"] if state else root_key)
+    module, params = build_agent(
+        dist, cfg, obs_space, action_space, init_key, state["params"] if state else None
+    )
+
+    tx = clipped(instantiate(cfg.algo.optimizer), cfg.algo.get("max_grad_norm", 0.0))
+    opt_state = state["opt_state"] if state else tx.init(params)
+
+    rollout_steps = int(cfg.algo.rollout_steps)
+    rb = ReplayBuffer(
+        rollout_steps,
+        num_envs,
+        obs_keys=obs_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+    )
+
+    total_batch = rollout_steps * num_envs
+    mb_size = int(cfg.algo.per_rank_batch_size) * dist.world_size
+    if total_batch % mb_size != 0:
+        raise ValueError(
+            f"rollout_steps*num_envs ({total_batch}) must be divisible by "
+            f"per_rank_batch_size*world_size ({mb_size})"
+        )
+    num_minibatches = total_batch // mb_size
+
+    act = make_act_fn(module)
+    value_fn = make_value_fn(module)
+    update = make_update_fn(module, tx, cfg, num_minibatches, mb_size)
+    gae_fn = jax.jit(partial(gae_op, num_steps=rollout_steps, gamma=cfg.algo.gamma, gae_lambda=cfg.algo.gae_lambda))
+
+    aggregator = MetricAggregator(
+        {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
+    )
+    ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
+
+    # -- counters ----------------------------------------------------------
+    policy_steps_per_iter = num_envs * rollout_steps
+    num_updates = int(cfg.algo.total_steps) // policy_steps_per_iter if not cfg.dry_run else 1
+    start_iter = (state["update"] + 1) if state else 1
+    policy_step = state["policy_step"] if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+
+    obs, _ = envs.reset(seed=cfg.seed)
+
+    for update_iter in range(start_iter, num_updates + 1):
+        with timer("Time/env_interaction_time"):
+            for _ in range(rollout_steps):
+                device_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
+                root_key, act_key = jax.random.split(root_key)
+                actions, logprobs, values = act(params, device_obs, act_key)
+                np_actions = np.asarray(actions)
+                if module.is_continuous:
+                    env_actions = np_actions.reshape(num_envs, -1)
+                elif isinstance(action_space, gym.spaces.MultiDiscrete):
+                    env_actions = np_actions.reshape(num_envs, -1)
+                else:
+                    env_actions = np_actions.reshape(num_envs)
+                next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
+                policy_step += num_envs
+
+                rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, 1)
+                dones = np.logical_or(terminated, truncated).astype(np.float32).reshape(num_envs, 1)
+
+                # truncation bootstrapping (reference ppo.py:286-305)
+                if np.any(truncated) and "final_obs" in info:
+                    final_obs = info["final_obs"]
+                    trunc_idx = np.nonzero(truncated)[0]
+                    stacked = {
+                        k: np.stack([np.asarray(final_obs[i][k]) for i in trunc_idx])
+                        for k in obs_keys
+                    }
+                    vals = np.asarray(
+                        value_fn(params, prepare_obs(stacked, cnn_keys, mlp_keys, len(trunc_idx)))
+                    )
+                    rewards[trunc_idx] += cfg.algo.gamma * vals.reshape(-1, 1)
+
+                step_data: Dict[str, np.ndarray] = {}
+                for k in obs_keys:
+                    step_data[f"obs:{k}"] = np.asarray(obs[k]).reshape(1, num_envs, *obs_space[k].shape)
+                step_data["actions"] = np_actions.reshape(1, num_envs, -1).astype(np.float32)
+                step_data["logprobs"] = np.asarray(logprobs).reshape(1, num_envs, 1)
+                step_data["values"] = np.asarray(values).reshape(1, num_envs, 1)
+                step_data["rewards"] = rewards.reshape(1, num_envs, 1)
+                step_data["dones"] = dones.reshape(1, num_envs, 1)
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+                obs = next_obs
+
+                for ep_rew, ep_len in episode_stats(info):
+                    aggregator.update("Rewards/rew_avg", ep_rew)
+                    aggregator.update("Game/ep_len_avg", ep_len)
+
+        # -- estimate returns (device, reverse scan) -----------------------
+        with timer("Time/train_time"):
+            local = rb.buffer  # [T, N, ...]
+            next_value = value_fn(params, prepare_obs(obs, cnn_keys, mlp_keys, num_envs))
+            returns, advantages = gae_fn(
+                jnp.asarray(local["rewards"]),
+                jnp.asarray(local["values"]),
+                jnp.asarray(local["dones"]),
+                next_value,
+            )
+
+            data = {k: jnp.asarray(v).reshape(total_batch, *v.shape[2:]) for k, v in local.items()}
+            data["returns"] = returns.reshape(total_batch, 1)
+            data["advantages"] = advantages.reshape(total_batch, 1)
+            data = {k: jax.device_put(v, dist.batch_sharding) for k, v in data.items()}
+
+            # anneal (traced scalars → no retrace; reference ppo.py:414-424)
+            frac = 1.0
+            if cfg.algo.anneal_lr:
+                frac = 1.0 - (update_iter - 1) / max(num_updates, 1)
+            coefs = {
+                "clip_coef": jnp.asarray(
+                    linear_annealing(cfg.algo.clip_coef, update_iter - 1, num_updates)
+                    if cfg.algo.anneal_clip_coef
+                    else cfg.algo.clip_coef,
+                    jnp.float32,
+                ),
+                "ent_coef": jnp.asarray(
+                    linear_annealing(cfg.algo.ent_coef, update_iter - 1, num_updates)
+                    if cfg.algo.anneal_ent_coef
+                    else cfg.algo.ent_coef,
+                    jnp.float32,
+                ),
+                "vf_coef": jnp.asarray(cfg.algo.vf_coef, jnp.float32),
+                "lr_frac": jnp.asarray(frac, jnp.float32),
+            }
+            root_key, up_key = jax.random.split(root_key)
+            params, opt_state, metrics = update(params, opt_state, data, coefs, up_key)
+
+        if aggregator is not None:
+            for k, v in metrics.items():
+                aggregator.update(k, np.asarray(v))
+
+        # -- logging -------------------------------------------------------
+        if rank == 0 and logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
+            computed = aggregator.compute()
+            logger.log_metrics(computed, policy_step)
+            aggregator.reset()
+            timings = timer.compute()
+            if timings:
+                if "Time/train_time" in timings and timings["Time/train_time"] > 0:
+                    logger.log_metrics(
+                        {"Time/sps_train": (policy_step - last_log) / timings["Time/train_time"]},
+                        policy_step,
+                    )
+                if "Time/env_interaction_time" in timings and timings["Time/env_interaction_time"] > 0:
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (policy_step - last_log)
+                            / timings["Time/env_interaction_time"]
+                        },
+                        policy_step,
+                    )
+                timer.reset()
+            last_log = policy_step
+
+        # -- checkpoint ----------------------------------------------------
+        if (
+            cfg.checkpoint.every > 0
+            and policy_step - last_checkpoint >= cfg.checkpoint.every
+        ) or cfg.dry_run or update_iter == num_updates:
+            last_checkpoint = policy_step
+            ckpt.save(
+                policy_step,
+                {
+                    "params": params,
+                    "opt_state": opt_state,
+                    "update": update_iter,
+                    "policy_step": policy_step,
+                    "last_log": last_log,
+                    "last_checkpoint": last_checkpoint,
+                    "rng": root_key,
+                },
+            )
+
+    envs.close()
+    if rank == 0 and cfg.algo.run_test:
+        test_env = vectorize(
+            Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}}),
+            cfg.seed,
+            rank,
+            log_dir,
+        ).envs[0]
+        test(module, params, test_env, cfg, log_dir, logger)
+    if rank == 0 and not cfg.model_manager.disabled:
+        from ...utils.model_manager import register_model
+
+        register_model(cfg, {"agent": params}, log_dir)
+    if logger is not None:
+        logger.close()
+
+
+@register_evaluation(algorithms="ppo")
+def evaluate_ppo(dist: Distributed, cfg: Config, state: Dict[str, Any]) -> None:
+    """Reference ppo/evaluate.py:15: rebuild env+agent from checkpoint, test."""
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
+    logger = get_logger(cfg, log_dir, dist.process_index)
+    env = vectorize(cfg, cfg.seed, 0, log_dir).envs[0]
+    root_key = dist.seed_everything(cfg.seed)
+    obs_space = env.observation_space
+    module, params = build_agent(
+        dist, cfg, obs_space, env.action_space, root_key, state["params"]
+    )
+    test(module, params, env, cfg, log_dir, logger)

@@ -1,0 +1,66 @@
+"""PPO per-algo contract: AGGREGATOR_KEYS / MODELS_TO_REGISTER / prepare_obs /
+test (reference sheeprl/algos/ppo/utils.py)."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/entropy_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def prepare_obs(obs: Dict[str, np.ndarray], cnn_keys=(), mlp_keys=(), num_envs: int = 1) -> Dict[str, jax.Array]:
+    """Host numpy obs → device arrays. Images stay uint8 NHWC (the encoder
+    normalizes); vectors become f32 (reference ppo/utils.py prepare_obs)."""
+    out: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        out[k] = jnp.asarray(obs[k]).reshape(num_envs, *obs[k].shape[-3:])
+    for k in mlp_keys:
+        out[k] = jnp.asarray(obs[k], dtype=jnp.float32).reshape(num_envs, -1)
+    return out
+
+
+def test(module: Any, params: Any, env: Any, cfg: Any, log_dir: str, logger=None, aggregator=None) -> float:
+    """Greedy single-episode rollout (reference ppo/utils.py test)."""
+    from .agent import actions_and_log_probs
+
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+
+    @jax.jit
+    def act(p, o):
+        actor_out, _ = module.apply({"params": p}, o)
+        actions, _, _ = actions_and_log_probs(actor_out, module.is_continuous, greedy=True)
+        return actions
+
+    done = False
+    cumulative_rew = 0.0
+    obs, _ = env.reset(seed=cfg.seed)
+    while not done:
+        torch_obs = prepare_obs(obs, cnn_keys, mlp_keys, 1)
+        actions = np.asarray(act(params, torch_obs))
+        if module.is_continuous:
+            env_actions = actions.reshape(env.action_space.shape)
+        elif actions.shape[-1] > 1:
+            env_actions = actions.reshape(-1)
+        else:
+            env_actions = actions.reshape(()).item()
+        obs, reward, terminated, truncated, _ = env.step(env_actions)
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.get("dry_run", False):
+            done = True
+    if logger is not None:
+        logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    print(f"Test - Reward: {cumulative_rew}")
+    env.close()
+    return cumulative_rew

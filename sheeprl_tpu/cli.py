@@ -1,0 +1,227 @@
+"""Command-line entrypoints: `run`, `evaluation`, `registration`.
+
+Mirrors the reference CLI (sheeprl/cli.py): `run` (:358) composes the config,
+validates it (:271 `check_configs`), optionally merges a resume checkpoint's
+config (:23-57), resolves the algorithm in the registry (:60-105) and launches
+the entrypoint; `evaluation` (:369) rebuilds a run from its checkpoint with
+devices/envs forced to 1 (:202-268); `registration` (:408) drives the model
+manager.
+
+Fabric's `launch` spawns one process per device in the reference; in JAX the
+single controller drives all local devices, so "launch" is simply: build the
+`Distributed` mesh, seed, and call `main(dist, cfg)` in-process.
+"""
+from __future__ import annotations
+
+import importlib
+import os
+import pathlib
+import sys
+import warnings
+from typing import Any, Dict, List, Optional, Sequence
+
+from .config import Config, compose, load_config_file, save_config
+from .parallel import build_distributed
+from .utils.registry import algorithm_registry, evaluation_registry, get_algorithm, get_evaluation
+from .utils.timer import timer
+from .utils.utils import print_config
+
+
+def resume_from_checkpoint(cfg: Config) -> Config:
+    """Merge the old run's saved config under the new one, protecting the
+    user-specified keys (reference cli.py:23-57)."""
+    ckpt_path = pathlib.Path(cfg.checkpoint.resume_from)
+    old_cfg_path = ckpt_path.parent.parent / "config.yaml"
+    if not old_cfg_path.is_file():
+        raise FileNotFoundError(
+            f"Cannot resume from {ckpt_path}: missing saved config at {old_cfg_path}"
+        )
+    old_cfg = load_config_file(old_cfg_path)
+    if old_cfg.select("env.id") != cfg.select("env.id"):
+        raise ValueError(
+            f"Cannot resume: checkpoint was trained on env '{old_cfg.select('env.id')}' "
+            f"but the current config selects '{cfg.select('env.id')}'"
+        )
+    if old_cfg.select("algo.name") != cfg.select("algo.name"):
+        raise ValueError(
+            f"Cannot resume: checkpoint algorithm is '{old_cfg.select('algo.name')}' "
+            f"but the current config selects '{cfg.select('algo.name')}'"
+        )
+    # Old run's parameters win over the freshly composed defaults, except the
+    # explicitly protected keys (reference cli.py:49-57 pops these from the
+    # old config before `cfg.merge_with(old_cfg)`).
+    protected = {
+        "algo.total_steps": cfg.select("algo.total_steps"),
+        "algo.learning_starts": cfg.select("algo.learning_starts"),
+        "root_dir": cfg.select("root_dir"),
+        "run_name": cfg.select("run_name"),
+        "checkpoint.resume_from": cfg.select("checkpoint.resume_from"),
+    }
+    merged = Config(cfg.to_dict())
+    merged.merge(old_cfg)
+    for path, value in protected.items():
+        if value is not None:
+            merged.set_path(path, value)
+    return merged
+
+
+def check_configs(cfg: Config) -> None:
+    """Config sanity checks (reference cli.py:271-356, minus torch-isms)."""
+    algo_name = cfg.select("algo.name")
+    if algo_name is None:
+        raise ValueError("Missing `algo.name`: select an experiment with `exp=<name>`")
+    if algo_name not in algorithm_registry:
+        raise ValueError(
+            f"Algorithm '{algo_name}' is not registered. Available: {sorted(algorithm_registry)}"
+        )
+    strategy = cfg.select("fabric.strategy", "auto")
+    if strategy not in ("auto", "ddp", "dp", None):
+        raise ValueError(
+            f"Unsupported fabric.strategy '{strategy}': the TPU build expresses data "
+            "parallelism via the device mesh; use fabric.devices to scale"
+        )
+    decoupled = algorithm_registry[algo_name]["decoupled"]
+    if decoupled and int(cfg.select("fabric.devices", 1)) < 2:
+        raise RuntimeError(
+            f"'{algo_name}' is a decoupled algorithm: it needs at least one player and "
+            "one trainer device (fabric.devices >= 2)"
+        )
+
+
+def run_algorithm(cfg: Config) -> None:
+    """Registry lookup → mesh build → entrypoint (reference cli.py:60-200)."""
+    entry = get_algorithm(cfg.algo.name)
+    module = importlib.import_module(entry["module"])
+    fn = getattr(module, entry["entrypoint"])
+    dist = build_distributed(cfg)
+    if cfg.select("metric.log_level", 1) == 0:
+        from .utils.metric import MetricAggregator
+
+        MetricAggregator.disabled = True
+    if cfg.select("metric.disable_timer", False):
+        timer.disabled = True
+    fn(dist, cfg)
+
+
+def eval_algorithm(cfg: Config) -> None:
+    """Evaluation launcher (reference cli.py:202-268): devices=1, num_envs=1."""
+    cfg.set_path("fabric.devices", 1)
+    cfg.set_path("env.num_envs", 1)
+    cfg.set_path("env.capture_video", bool(cfg.select("env.capture_video", False)))
+    entry = get_evaluation(cfg.algo.name)
+    module = importlib.import_module(entry["module"])
+    fn = getattr(module, entry["entrypoint"])
+    dist = build_distributed(cfg)
+    from .utils.checkpoint import CheckpointManager
+
+    state = CheckpointManager.load(cfg.checkpoint_path)
+    fn(dist, cfg, state)
+
+
+def run(args: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl_tpu run [exp=... key=value ...]` (reference cli.py:358-366)."""
+    argv = list(args if args is not None else sys.argv[1:])
+    import sheeprl_tpu  # ensure registries are populated
+
+    cfg = compose("config", argv)
+    if cfg.select("checkpoint.resume_from"):
+        cfg = resume_from_checkpoint(cfg)
+    check_configs(cfg)
+    print_config(cfg)
+    run_algorithm(cfg)
+
+
+def evaluation(args: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl_tpu eval checkpoint_path=... [key=value ...]`
+    (reference cli.py:369-405): rebuild the run config from the checkpoint's
+    saved config.yaml, then launch the registered evaluation fn."""
+    argv = list(args if args is not None else sys.argv[1:])
+    import sheeprl_tpu  # ensure registries are populated
+
+    ckpt: Optional[str] = None
+    rest: List[str] = []
+    for a in argv:
+        if a.startswith("checkpoint_path="):
+            ckpt = a.split("=", 1)[1]
+        else:
+            rest.append(a)
+    if ckpt is None:
+        raise ValueError("evaluation requires `checkpoint_path=<path to .ckpt>`")
+    ckpt_path = pathlib.Path(ckpt)
+    if not ckpt_path.is_file():
+        raise FileNotFoundError(f"Checkpoint not found: {ckpt_path}")
+    cfg_path = ckpt_path.parent.parent / "config.yaml"
+    if not cfg_path.is_file():
+        raise FileNotFoundError(f"Missing saved config beside checkpoint: {cfg_path}")
+    cfg = load_config_file(cfg_path)
+    for ov in rest:
+        if "=" in ov:
+            k, _, v = ov.partition("=")
+            import yaml
+
+            cfg.set_path(k, yaml.safe_load(v))
+    cfg["checkpoint_path"] = str(ckpt_path)
+    # reference cli.py:371-401: disable loggers/ckpt writes during eval
+    cfg.set_path("metric.log_level", cfg.select("metric.log_level", 1))
+    eval_algorithm(cfg)
+
+
+def registration(args: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl_tpu registration checkpoint_path=...` — register a trained
+    model in the local model registry (reference cli.py:408-450, MLflow
+    replaced by the file-based registry in utils/model_manager.py)."""
+    argv = list(args if args is not None else sys.argv[1:])
+    import sheeprl_tpu  # ensure registries are populated
+    from .utils.model_manager import register_models_from_checkpoint
+
+    ckpt: Optional[str] = None
+    rest: List[str] = []
+    for a in argv:
+        if a.startswith("checkpoint_path="):
+            ckpt = a.split("=", 1)[1]
+        else:
+            rest.append(a)
+    if ckpt is None:
+        raise ValueError("registration requires `checkpoint_path=<path to .ckpt>`")
+    register_models_from_checkpoint(pathlib.Path(ckpt), rest)
+
+
+def available_agents() -> None:
+    """Rich table of registered algorithms (reference available_agents.py:7)."""
+    import sheeprl_tpu
+
+    try:
+        from rich.console import Console
+        from rich.table import Table
+
+        table = Table(title="SheepRL-TPU agents")
+        table.add_column("Algorithm")
+        table.add_column("Entrypoint")
+        table.add_column("Decoupled")
+        for name, info in sorted(algorithm_registry.items()):
+            table.add_row(name, f"{info['module']}.{info['entrypoint']}", str(info["decoupled"]))
+        Console().print(table)
+    except Exception:
+        for name, info in sorted(algorithm_registry.items()):
+            print(f"{name}: {info['module']}.{info['entrypoint']} decoupled={info['decoupled']}")
+
+
+def main() -> None:
+    """Console dispatcher: `python -m sheeprl_tpu <run|eval|registration|agents> ...`"""
+    argv = sys.argv[1:]
+    if argv and argv[0] in ("run", "eval", "evaluation", "registration", "agents"):
+        cmd, rest = argv[0], argv[1:]
+    else:
+        cmd, rest = "run", argv
+    if cmd == "run":
+        run(rest)
+    elif cmd in ("eval", "evaluation"):
+        evaluation(rest)
+    elif cmd == "registration":
+        registration(rest)
+    elif cmd == "agents":
+        available_agents()
+
+
+if __name__ == "__main__":
+    main()

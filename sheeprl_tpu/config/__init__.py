@@ -1,0 +1,14 @@
+from .container import Config, resolve_interpolations
+from .compose import compose, load_config_file, save_config, CONFIG_ROOT
+from .instantiate import instantiate, locate
+
+__all__ = [
+    "Config",
+    "compose",
+    "instantiate",
+    "locate",
+    "load_config_file",
+    "save_config",
+    "resolve_interpolations",
+    "CONFIG_ROOT",
+]

@@ -1,0 +1,17 @@
+from .buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+)
+from .memmap import MemmapArray
+from .prefetch import DevicePrefetcher
+
+__all__ = [
+    "EnvIndependentReplayBuffer",
+    "EpisodeBuffer",
+    "ReplayBuffer",
+    "SequentialReplayBuffer",
+    "MemmapArray",
+    "DevicePrefetcher",
+]

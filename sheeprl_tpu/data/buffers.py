@@ -1,0 +1,561 @@
+"""Host-side replay buffers (numpy, optionally memory-mapped).
+
+Re-implements the reference buffer family with the same semantics
+(sheeprl/data/buffers.py): `ReplayBuffer` (:20-360), `SequentialReplayBuffer`
+(:363-526), `EnvIndependentReplayBuffer` (:529-743), `EpisodeBuffer`
+(:746-1155). Buffers are *unjittable host state* by design (SURVEY.md §7):
+experience lives in numpy on the host; sampled batches cross to HBM through
+`sample_device` / the `DevicePrefetcher` (the async host→device pipeline the
+reference lacks).
+
+Layout conventions match the reference: `ReplayBuffer` stores
+[buffer_size, n_envs, ...]; samples come back [n_samples, batch, ...];
+`SequentialReplayBuffer.sample` returns [n_samples, seq_len, batch, ...].
+"""
+from __future__ import annotations
+
+import os
+import typing
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .memmap import MemmapArray
+
+if typing.TYPE_CHECKING:
+    import jax
+
+
+def _as_storage(shape: Sequence[int], dtype: Any, memmap: bool, memmap_dir: Optional[Path], key: str):
+    if memmap:
+        filename = None if memmap_dir is None else memmap_dir / f"{key}.memmap"
+        return MemmapArray(shape, dtype=dtype, filename=filename)
+    return np.zeros(shape, dtype=dtype)
+
+
+class ReplayBuffer:
+    """Circular dict buffer of shape [buffer_size, n_envs, ...] per key."""
+
+    batch_axis: int = 1
+
+    def __init__(
+        self,
+        buffer_size: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        memmap: bool = False,
+        memmap_dir: Optional[Union[str, os.PathLike]] = None,
+        **kwargs: Any,
+    ):
+        if buffer_size <= 0:
+            raise ValueError(f"buffer_size must be > 0, got {buffer_size}")
+        if n_envs <= 0:
+            raise ValueError(f"n_envs must be > 0, got {n_envs}")
+        self._buffer_size = int(buffer_size)
+        self._n_envs = int(n_envs)
+        self._obs_keys = tuple(obs_keys)
+        self._memmap = memmap
+        self._memmap_dir = Path(memmap_dir) if memmap_dir is not None else None
+        if memmap and self._memmap_dir is not None:
+            self._memmap_dir.mkdir(parents=True, exist_ok=True)
+        self._buf: Dict[str, Any] = {}
+        self._pos = 0
+        self._full = False
+
+    # -- properties --------------------------------------------------------
+    @property
+    def buffer(self) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self._buf.items()}
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def full(self) -> bool:
+        return self._full
+
+    @property
+    def is_memmap(self) -> bool:
+        return self._memmap
+
+    @property
+    def empty(self) -> bool:
+        return len(self._buf) == 0
+
+    def __len__(self) -> int:
+        return self._buffer_size
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._buf
+
+    def keys(self):
+        return self._buf.keys()
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        return np.asarray(self._buf[key])
+
+    def __setitem__(self, key: str, value: np.ndarray) -> None:
+        value = np.asarray(value)
+        expected = (self._buffer_size, self._n_envs)
+        if value.shape[:2] != expected:
+            raise ValueError(f"value for '{key}' must lead with {expected}, got {value.shape}")
+        self._buf[key] = value
+
+    def _maybe_create(self, key: str, item_shape: Tuple[int, ...], dtype: Any) -> None:
+        if key not in self._buf:
+            self._buf[key] = _as_storage(
+                (self._buffer_size, self._n_envs) + tuple(item_shape),
+                dtype,
+                self._memmap,
+                self._memmap_dir,
+                key,
+            )
+
+    # -- add ---------------------------------------------------------------
+    def add(self, data: Dict[str, np.ndarray], validate_args: bool = False) -> None:
+        """Append [T, n_envs, ...] per key, wrapping around circularly
+        (reference buffers.py:145-221)."""
+        if validate_args:
+            if not isinstance(data, dict):
+                raise ValueError(f"'data' must be a dict, got {type(data)}")
+            lengths = {k: v.shape[0] for k, v in data.items()}
+            if len(set(lengths.values())) > 1:
+                raise RuntimeError(f"Inconsistent time dimension across keys: {lengths}")
+            for k, v in data.items():
+                if v.ndim < 2 or v.shape[1] != self._n_envs:
+                    raise RuntimeError(
+                        f"'{k}' must be [T, n_envs={self._n_envs}, ...], got {v.shape}"
+                    )
+        t = next(iter(data.values())).shape[0]
+        if t == 0:
+            return
+        for k, v in data.items():
+            self._maybe_create(k, v.shape[2:], v.dtype)
+        idxs = (self._pos + np.arange(t)) % self._buffer_size
+        for k, v in data.items():
+            if t >= self._buffer_size:
+                # only the last buffer_size items survive a wrap-over-write
+                self._buf[k][idxs[-self._buffer_size :]] = v[-self._buffer_size :]
+            else:
+                self._buf[k][idxs] = v
+        if self._pos + t >= self._buffer_size:
+            self._full = True
+        self._pos = int((self._pos + t) % self._buffer_size)
+
+    # -- sample ------------------------------------------------------------
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        """Uniform sample → dict of [n_samples, batch_size, ...]
+        (reference buffers.py:223-288)."""
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError("batch_size and n_samples must be > 0")
+        if not self._full and self._pos == 0:
+            raise ValueError("No data in the buffer, cannot sample")
+        total = batch_size * n_samples
+        if self._full:
+            valid = self._buffer_size
+            if sample_next_obs:
+                # the slot right before _pos has its "next" overwritten by the
+                # write head (reference :230 SB3-derived comment): valid
+                # indices are [pos, pos+size-1) mod size — everything but pos-1
+                idxs = (self._pos + np.random.randint(0, valid - 1, size=total)) % self._buffer_size
+            else:
+                idxs = np.random.randint(0, valid, size=total)
+        else:
+            upper = self._pos - 1 if sample_next_obs else self._pos
+            if upper <= 0:
+                raise RuntimeError("Not enough data to sample next observations")
+            idxs = np.random.randint(0, upper, size=total)
+        env_idxs = np.random.randint(0, self._n_envs, size=total)
+        return self._gather(idxs, env_idxs, batch_size, n_samples, sample_next_obs, clone)
+
+    def _gather(
+        self,
+        idxs: np.ndarray,
+        env_idxs: np.ndarray,
+        batch_size: int,
+        n_samples: int,
+        sample_next_obs: bool,
+        clone: bool,
+    ) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for k, v in self._buf.items():
+            arr = np.asarray(v)
+            taken = arr[idxs, env_idxs]
+            out[k] = taken.reshape(n_samples, batch_size, *arr.shape[2:]).copy() if clone else taken.reshape(
+                n_samples, batch_size, *arr.shape[2:]
+            )
+        if sample_next_obs:
+            nxt = (idxs + 1) % self._buffer_size
+            for k in self._obs_keys:
+                if k in self._buf:
+                    arr = np.asarray(self._buf[k])
+                    out[f"next_{k}"] = arr[nxt, env_idxs].reshape(
+                        n_samples, batch_size, *arr.shape[2:]
+                    )
+        return out
+
+    def sample_device(self, batch_size: int, sharding: Any = None, **kwargs: Any):
+        """Sample and transfer to device (the host→HBM hop)."""
+        import jax
+
+        batch = self.sample(batch_size, **kwargs)
+        if sharding is None:
+            return jax.tree.map(jax.numpy.asarray, batch)
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+    # -- (de)serialization -------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "buffer": {k: np.asarray(v).copy() for k, v in self._buf.items()},
+            "pos": self._pos,
+            "full": self._full,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> "ReplayBuffer":
+        for k, v in state["buffer"].items():
+            self._maybe_create(k, v.shape[2:], v.dtype)
+            self._buf[k][:] = v
+        self._pos = int(state["pos"])
+        self._full = bool(state["full"])
+        return self
+
+    @staticmethod
+    def from_state_dict(state: Dict[str, Any], **kwargs: Any) -> "ReplayBuffer":
+        any_arr = next(iter(state["buffer"].values()))
+        rb = ReplayBuffer(any_arr.shape[0], any_arr.shape[1], **kwargs)
+        return rb.load_state_dict(state)
+
+
+class SequentialReplayBuffer(ReplayBuffer):
+    """Samples contiguous length-`sequence_length` windows ignoring episode
+    bounds (reference buffers.py:363-526). Returns [n_samples, seq_len,
+    batch_size, ...] (batch_axis=2)."""
+
+    batch_axis: int = 2
+
+    def sample(  # type: ignore[override]
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        sequence_length: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError("batch_size and n_samples must be > 0")
+        if not self._full and self._pos == 0:
+            raise ValueError("No data in the buffer, cannot sample")
+        L = sequence_length
+        if not self._full and self._pos - L + 1 < 1:
+            raise ValueError(
+                f"Cannot sample a sequence of length {L}: only {self._pos} steps stored"
+            )
+        total = batch_size * n_samples
+        if self._full:
+            # valid starts: any index such that the window [s, s+L) does not
+            # cross the write head (reference :439-460)
+            first_valid = self._pos
+            n_valid = self._buffer_size - L + 1
+            offsets = np.random.randint(0, n_valid, size=total)
+            starts = (first_valid + offsets) % self._buffer_size
+        else:
+            starts = np.random.randint(0, self._pos - L + 1, size=total)
+        env_idxs = np.random.randint(0, self._n_envs, size=total)
+        seq = (starts[:, None] + np.arange(L)[None, :]) % self._buffer_size  # [total, L]
+        out: Dict[str, np.ndarray] = {}
+        for k, v in self._buf.items():
+            arr = np.asarray(v)
+            taken = arr[seq, env_idxs[:, None]]  # [total, L, ...]
+            taken = taken.reshape(n_samples, batch_size, L, *arr.shape[2:])
+            taken = np.swapaxes(taken, 1, 2)  # → [n_samples, L, batch, ...]
+            out[k] = taken.copy() if clone else taken
+        if sample_next_obs:
+            nxt = (seq + 1) % self._buffer_size
+            for k in self._obs_keys:
+                if k in self._buf:
+                    arr = np.asarray(self._buf[k])
+                    taken = arr[nxt, env_idxs[:, None]].reshape(
+                        n_samples, batch_size, L, *arr.shape[2:]
+                    )
+                    out[f"next_{k}"] = np.swapaxes(taken, 1, 2)
+        return out
+
+
+class EnvIndependentReplayBuffer:
+    """One sub-buffer per env, supporting per-env `add(indices)` (needed by
+    Dreamer's per-env reset handling) and multinomial cross-env sampling
+    (reference buffers.py:529-743)."""
+
+    def __init__(
+        self,
+        buffer_size: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        memmap: bool = False,
+        memmap_dir: Optional[Union[str, os.PathLike]] = None,
+        buffer_cls: type = SequentialReplayBuffer,
+        **kwargs: Any,
+    ):
+        mdir = Path(memmap_dir) if memmap_dir is not None else None
+        self._buffers: List[ReplayBuffer] = [
+            buffer_cls(
+                buffer_size,
+                n_envs=1,
+                obs_keys=obs_keys,
+                memmap=memmap,
+                memmap_dir=None if mdir is None else mdir / f"env_{i}",
+                **kwargs,
+            )
+            for i in range(n_envs)
+        ]
+        self._n_envs = n_envs
+        self._buffer_size = buffer_size
+        self._concat_along_axis = getattr(buffer_cls, "batch_axis", 1)
+
+    @property
+    def buffer(self) -> List[ReplayBuffer]:
+        return self._buffers
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def full(self) -> bool:
+        return all(b.full for b in self._buffers)
+
+    @property
+    def empty(self) -> bool:
+        return all(b.empty for b in self._buffers)
+
+    def __len__(self) -> int:
+        return self._buffer_size
+
+    def add(self, data: Dict[str, np.ndarray], indices: Optional[Sequence[int]] = None) -> None:
+        if indices is None:
+            indices = range(self._n_envs)
+        indices = list(indices)
+        for slot, env_idx in enumerate(indices):
+            self._buffers[env_idx].add({k: v[:, slot : slot + 1] for k, v in data.items()})
+
+    def sample(
+        self, batch_size: int, n_samples: int = 1, **kwargs: Any
+    ) -> Dict[str, np.ndarray]:
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError("batch_size and n_samples must be > 0")
+        ready = [b for b in self._buffers if not b.empty and (b.full or b._pos > 0)]
+        if not ready:
+            raise ValueError("No data in the buffer, cannot sample")
+        split = np.random.multinomial(batch_size, [1 / len(ready)] * len(ready))
+        parts = [
+            b.sample(int(bs), n_samples=n_samples, **kwargs)
+            for b, bs in zip(ready, split)
+            if bs > 0
+        ]
+        keys = parts[0].keys()
+        axis = self._concat_along_axis
+        return {k: np.concatenate([p[k] for p in parts], axis=axis) for k in keys}
+
+    def sample_device(self, batch_size: int, sharding: Any = None, **kwargs: Any):
+        import jax
+
+        batch = self.sample(batch_size, **kwargs)
+        if sharding is None:
+            return jax.tree.map(jax.numpy.asarray, batch)
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"buffers": [b.state_dict() for b in self._buffers]}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> "EnvIndependentReplayBuffer":
+        for b, s in zip(self._buffers, state["buffers"]):
+            b.load_state_dict(s)
+        return self
+
+
+class EpisodeBuffer:
+    """Whole-episode storage with boundary splitting, eviction and
+    `prioritize_ends` sequence sampling (reference buffers.py:746-1155)."""
+
+    def __init__(
+        self,
+        buffer_size: int,
+        minimum_episode_length: int = 1,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        prioritize_ends: bool = False,
+        memmap: bool = False,
+        memmap_dir: Optional[Union[str, os.PathLike]] = None,
+        **kwargs: Any,
+    ):
+        if buffer_size <= 0:
+            raise ValueError(f"buffer_size must be > 0, got {buffer_size}")
+        if minimum_episode_length <= 0 or minimum_episode_length > buffer_size:
+            raise ValueError(
+                f"minimum_episode_length must be in [1, {buffer_size}], got {minimum_episode_length}"
+            )
+        self._buffer_size = buffer_size
+        self._min_len = minimum_episode_length
+        self._n_envs = n_envs
+        self._obs_keys = tuple(obs_keys)
+        self._prioritize_ends = prioritize_ends
+        self._memmap = memmap
+        self._memmap_dir = Path(memmap_dir) if memmap_dir is not None else None
+        self._episodes: List[Dict[str, np.ndarray]] = []
+        self._open: List[Optional[Dict[str, List[np.ndarray]]]] = [None] * n_envs
+        self._cum_len = 0
+
+    @property
+    def buffer(self) -> List[Dict[str, np.ndarray]]:
+        return self._episodes
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def full(self) -> bool:
+        return self._cum_len >= self._buffer_size
+
+    @property
+    def is_memmap(self) -> bool:
+        return self._memmap
+
+    def __len__(self) -> int:
+        return self._cum_len
+
+    def add(self, data: Dict[str, np.ndarray], indices: Optional[Sequence[int]] = None) -> None:
+        """Append [T, n_envs, ...]; split at terminated|truncated
+        (reference :936-969). `data` must contain 'terminated'/'truncated'."""
+        if "terminated" not in data or "truncated" not in data:
+            raise RuntimeError("EpisodeBuffer.add requires 'terminated' and 'truncated' keys")
+        t = next(iter(data.values())).shape[0]
+        if indices is None:
+            indices = range(self._n_envs)
+        for slot, env_idx in enumerate(indices):
+            if self._open[env_idx] is None:
+                self._open[env_idx] = {k: [] for k in data}
+            open_ep = self._open[env_idx]
+            for k, v in data.items():
+                if k not in open_ep:
+                    open_ep[k] = []
+            done = (
+                np.asarray(data["terminated"][:, slot]) + np.asarray(data["truncated"][:, slot])
+            ).reshape(t) > 0
+            start = 0
+            for step in range(t):
+                for k, v in data.items():
+                    open_ep[k].append(np.asarray(v[step, slot]))
+                if done[step]:
+                    self._commit(env_idx)
+                    self._open[env_idx] = {k: [] for k in data}
+                    open_ep = self._open[env_idx]
+                    start = step + 1
+            del start
+
+    def _commit(self, env_idx: int) -> None:
+        open_ep = self._open[env_idx]
+        if open_ep is None:
+            return
+        length = len(next(iter(open_ep.values()), []))
+        if length < self._min_len:
+            return
+        if length > self._buffer_size:
+            raise RuntimeError(
+                f"Episode of length {length} exceeds buffer_size {self._buffer_size}"
+            )
+        ep = {k: np.stack(v, axis=0) for k, v in open_ep.items() if v}
+        self._episodes.append(ep)
+        self._cum_len += length
+        # evict oldest full episodes (reference :993-1014)
+        while self._cum_len > self._buffer_size and self._episodes:
+            old = self._episodes.pop(0)
+            self._cum_len -= len(next(iter(old.values())))
+
+    def sample(
+        self,
+        batch_size: int,
+        n_samples: int = 1,
+        clone: bool = False,
+        sequence_length: int = 1,
+        prioritize_ends: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        """Sample [n_samples, seq_len, batch, ...] windows from stored episodes
+        (reference :1016-1096)."""
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError("batch_size and n_samples must be > 0")
+        if prioritize_ends is None:
+            prioritize_ends = self._prioritize_ends
+        valid = [ep for ep in self._episodes if len(next(iter(ep.values()))) >= sequence_length]
+        if not valid:
+            raise RuntimeError(f"No episodes of length >= {sequence_length} to sample")
+        lengths = np.array([len(next(iter(ep.values()))) for ep in valid])
+        weights = lengths / lengths.sum()
+        total = batch_size * n_samples
+        ep_idx = np.random.choice(len(valid), size=total, p=weights)
+        samples: Dict[str, List[np.ndarray]] = {}
+        for i in ep_idx:
+            ep = valid[i]
+            ep_len = lengths[i]
+            upper = ep_len - sequence_length + 1
+            if prioritize_ends:
+                # bias starts so episode ends are reachable (reference :1092-1096)
+                start = min(np.random.randint(0, ep_len), upper - 1)
+            else:
+                start = np.random.randint(0, upper)
+            for k, v in ep.items():
+                samples.setdefault(k, []).append(v[start : start + sequence_length])
+        out: Dict[str, np.ndarray] = {}
+        for k, vs in samples.items():
+            arr = np.stack(vs, axis=0)  # [total, L, ...]
+            arr = arr.reshape(n_samples, batch_size, sequence_length, *arr.shape[2:])
+            arr = np.swapaxes(arr, 1, 2)
+            out[k] = arr.copy() if clone else arr
+        return out
+
+    def sample_device(self, batch_size: int, sharding: Any = None, **kwargs: Any):
+        import jax
+
+        batch = self.sample(batch_size, **kwargs)
+        if sharding is None:
+            return jax.tree.map(jax.numpy.asarray, batch)
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "episodes": [{k: v.copy() for k, v in ep.items()} for ep in self._episodes],
+            "open": [
+                None if o is None else {k: [x.copy() for x in v] for k, v in o.items()}
+                for o in self._open
+            ],
+            "cum_len": self._cum_len,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> "EpisodeBuffer":
+        self._episodes = state["episodes"]
+        self._open = state["open"]
+        self._cum_len = int(state["cum_len"])
+        return self

@@ -1,0 +1,91 @@
+"""Async host→HBM batch prefetching.
+
+The TPU-specific piece the reference lacks (SURVEY.md §7 step 2 /
+BASELINE.json north-star "replay buffers stream host→HBM with async device
+prefetch"): while the learner runs step N on device, the next sampled batch
+is already being staged with `jax.device_put` from a background thread, so
+env stepping / sampling stays on CPU and never stalls the TPU.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+
+
+class DevicePrefetcher:
+    """Wraps a `sample_fn() -> host_batch` into a double-buffered device
+    iterator. `depth` batches are staged ahead (device_put is async in JAX,
+    so staging only dispatches the transfer)."""
+
+    def __init__(
+        self,
+        sample_fn: Callable[[], Any],
+        sharding: Optional[Any] = None,
+        depth: int = 2,
+    ):
+        self.sample_fn = sample_fn
+        self.sharding = sharding
+        self.depth = max(1, depth)
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _put_device(self, batch: Any) -> Any:
+        if self.sharding is None:
+            return jax.tree.map(jax.numpy.asarray, batch)
+        return jax.tree.map(lambda x: jax.device_put(x, self.sharding), batch)
+
+    def _worker(self) -> None:
+        try:
+            while not self._stop.is_set():
+                batch = self._put_device(self.sample_fn())
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # surfaced on next __next__
+            self._exc = e
+
+    def start(self) -> "DevicePrefetcher":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._worker, daemon=True)
+            self._thread.start()
+        return self
+
+    def __iter__(self) -> Iterator[Any]:
+        self.start()
+        return self
+
+    def __next__(self) -> Any:
+        if self._thread is None:
+            self.start()
+        while True:
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+            try:
+                return self._q.get(timeout=1.0)
+            except queue.Empty:
+                if self._thread is not None and not self._thread.is_alive() and self._exc is None:
+                    raise StopIteration
+
+    def get(self) -> Any:
+        """Synchronous one-shot fetch (no background thread)."""
+        return self._put_device(self.sample_fn())
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        while not self._q.empty():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break

@@ -1,0 +1,456 @@
+"""Probability distributions for the RL losses (pure JAX).
+
+Re-implements the reference's distribution toolbox
+(sheeprl/utils/distribution.py, 414 LoC): `TruncatedNormal` (:25-147),
+`SymlogDistribution` (:152-193), `MSEDistribution` (:196-221),
+`TwoHotEncodingDistribution` (:224-276), `OneHotCategorical` (+ straight
+through) (:281-404), `BernoulliSafeMode` (:407-414) — plus the plain
+Normal/Categorical/Independent machinery torch.distributions provided.
+
+API convention: explicit PRNG keys (`sample(key)`); `rsample` is the
+reparameterized path (same as sample where applicable). Losses run in f32
+regardless of compute dtype — DreamerV3 KL/two-hot paths are bf16-sensitive
+(SURVEY.md §7 risks).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.transforms import symexp, symlog
+
+
+class Distribution:
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        raise NotImplementedError
+
+    def rsample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        return self.sample(key, sample_shape)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def entropy(self) -> jax.Array:
+        raise NotImplementedError
+
+    @property
+    def mode(self) -> jax.Array:
+        raise NotImplementedError
+
+    @property
+    def mean(self) -> jax.Array:
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc: jax.Array, scale: jax.Array):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, key, sample_shape=()):
+        shape = tuple(sample_shape) + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        eps = jax.random.normal(key, shape, dtype=self.loc.dtype)
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        var = jnp.square(self.scale)
+        return -0.5 * (jnp.square(value - self.loc) / var + jnp.log(2 * math.pi * var))
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale) * jnp.ones_like(self.loc)
+
+    @property
+    def mode(self):
+        return self.loc
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def stddev(self):
+        return self.scale * jnp.ones_like(self.loc)
+
+
+class Independent(Distribution):
+    """Sum log-probs/entropy over the last `reinterpreted_batch_ndims` dims."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_ndims: int = 1):
+        self.base = base
+        self.ndims = reinterpreted_batch_ndims
+
+    def _reduce(self, x: jax.Array) -> jax.Array:
+        if self.ndims == 0:
+            return x
+        return jnp.sum(x, axis=tuple(range(-self.ndims, 0)))
+
+    def sample(self, key, sample_shape=()):
+        return self.base.sample(key, sample_shape)
+
+    def rsample(self, key, sample_shape=()):
+        return self.base.rsample(key, sample_shape)
+
+    def log_prob(self, value):
+        return self._reduce(self.base.log_prob(value))
+
+    def entropy(self):
+        return self._reduce(self.base.entropy())
+
+    @property
+    def mode(self):
+        return self.base.mode
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+
+class Categorical(Distribution):
+    """Integer-valued categorical over the last axis of `logits`."""
+
+    def __init__(self, logits: Optional[jax.Array] = None, probs: Optional[jax.Array] = None):
+        if logits is None:
+            logits = jnp.log(jnp.clip(probs, 1e-12, None))
+        self.logits = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), axis=-1)
+
+    @property
+    def probs(self):
+        return jnp.exp(self.logits)
+
+    def sample(self, key, sample_shape=()):
+        shape = tuple(sample_shape) + self.logits.shape[:-1]
+        return jax.random.categorical(key, self.logits, axis=-1, shape=shape)
+
+    def log_prob(self, value):
+        value = value.astype(jnp.int32)
+        return jnp.take_along_axis(self.logits, value[..., None], axis=-1)[..., 0]
+
+    def entropy(self):
+        return -jnp.sum(self.probs * self.logits, axis=-1)
+
+    @property
+    def mode(self):
+        return jnp.argmax(self.logits, axis=-1)
+
+    @property
+    def mean(self):  # undefined for categorical; parity with torch (nan)
+        return jnp.full(self.logits.shape[:-1], jnp.nan)
+
+
+class OneHotCategorical(Categorical):
+    """One-hot-valued categorical (reference distribution.py:281-340)."""
+
+    def sample(self, key, sample_shape=()):
+        idx = super().sample(key, sample_shape)
+        return jax.nn.one_hot(idx, self.logits.shape[-1], dtype=self.logits.dtype)
+
+    def log_prob(self, value):
+        return jnp.sum(value * self.logits, axis=-1)
+
+    @property
+    def mode(self):
+        return jax.nn.one_hot(jnp.argmax(self.logits, axis=-1), self.logits.shape[-1], dtype=self.logits.dtype)
+
+    @property
+    def mean(self):
+        return self.probs
+
+
+class OneHotCategoricalStraightThrough(OneHotCategorical):
+    """Sample one-hot with straight-through gradients to `probs`
+    (reference distribution.py:343-370) — the discrete-RSSM sampler."""
+
+    def rsample(self, key, sample_shape=()):
+        sample = jax.lax.stop_gradient(self.sample(key, sample_shape))
+        probs = self.probs
+        return sample + probs - jax.lax.stop_gradient(probs)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, logits: jax.Array):
+        self.logits = jnp.asarray(logits, jnp.float32)
+
+    @property
+    def probs(self):
+        return nnsigmoid(self.logits)
+
+    def sample(self, key, sample_shape=()):
+        shape = tuple(sample_shape) + self.logits.shape
+        return jax.random.bernoulli(key, self.probs, shape).astype(jnp.float32)
+
+    def log_prob(self, value):
+        return -optax_sigmoid_bce(self.logits, value)
+
+    def entropy(self):
+        p = self.probs
+        return -(p * jnp.log(jnp.clip(p, 1e-12, None)) + (1 - p) * jnp.log(jnp.clip(1 - p, 1e-12, None)))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def mode(self):
+        return (self.probs > 0.5).astype(jnp.float32)
+
+
+class BernoulliSafeMode(Bernoulli):
+    """Bernoulli whose mode is well-defined at p=0.5 (reference :407-414)."""
+
+    @property
+    def mode(self):
+        return (self.probs > 0.5).astype(jnp.float32)
+
+
+def nnsigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def optax_sigmoid_bce(logits, labels):
+    """Numerically-stable BCE-with-logits."""
+    return jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+
+
+CONST_SQRT_2 = math.sqrt(2)
+CONST_INV_SQRT_2PI = 1 / math.sqrt(2 * math.pi)
+CONST_INV_SQRT_2 = 1 / math.sqrt(2)
+CONST_LOG_INV_SQRT_2PI = math.log(CONST_INV_SQRT_2PI)
+CONST_LOG_SQRT_2PI_E = 0.5 * math.log(2 * math.pi * math.e)
+
+
+class TruncatedStandardNormal(Distribution):
+    """Standard normal truncated to [a, b] (reference distribution.py:25-114,
+    itself from github.com/toshas/torch_truncnorm). Sampling via inverse-CDF."""
+
+    def __init__(self, a: jax.Array, b: jax.Array):
+        self.a = jnp.asarray(a, jnp.float32)
+        self.b = jnp.asarray(b, jnp.float32)
+        self._little_phi_a = self._little_phi(self.a)
+        self._little_phi_b = self._little_phi(self.b)
+        self._big_phi_a = self._big_phi(self.a)
+        self._big_phi_b = self._big_phi(self.b)
+        self._Z = jnp.clip(self._big_phi_b - self._big_phi_a, 1e-8, None)
+        self._log_Z = jnp.log(self._Z)
+        little_phi_coeff_a = jnp.nan_to_num(self.a, nan=math.nan)
+        little_phi_coeff_b = jnp.nan_to_num(self.b, nan=math.nan)
+        self._lpbb_m_lpaa_d_Z = (
+            self._little_phi_b * little_phi_coeff_b - self._little_phi_a * little_phi_coeff_a
+        ) / self._Z
+
+    @staticmethod
+    def _little_phi(x):
+        return jnp.exp(-0.5 * x * x) * CONST_INV_SQRT_2PI
+
+    @staticmethod
+    def _big_phi(x):
+        return 0.5 * (1 + jax.lax.erf(x * CONST_INV_SQRT_2))
+
+    @staticmethod
+    def _inv_big_phi(x):
+        return CONST_SQRT_2 * jax.lax.erf_inv(2 * x - 1)
+
+    @property
+    def mean(self):
+        return -(self._little_phi_b - self._little_phi_a) / self._Z
+
+    @property
+    def mode(self):
+        return jnp.clip(jnp.zeros_like(self.a), self.a, self.b)
+
+    @property
+    def variance(self):
+        return 1 - self._lpbb_m_lpaa_d_Z - jnp.square((self._little_phi_b - self._little_phi_a) / self._Z)
+
+    def entropy(self):
+        return CONST_LOG_SQRT_2PI_E + self._log_Z - 0.5 * self._lpbb_m_lpaa_d_Z
+
+    def cdf(self, value):
+        return jnp.clip((self._big_phi(value) - self._big_phi_a) / self._Z, 0, 1)
+
+    def icdf(self, value):
+        return self._inv_big_phi(self._big_phi_a + value * self._Z)
+
+    def log_prob(self, value):
+        return CONST_LOG_INV_SQRT_2PI - self._log_Z - 0.5 * jnp.square(value)
+
+    def sample(self, key, sample_shape=()):
+        shape = tuple(sample_shape) + jnp.broadcast_shapes(self.a.shape, self.b.shape)
+        eps = jnp.finfo(jnp.float32).eps
+        u = jax.random.uniform(key, shape, minval=eps, maxval=1 - eps)
+        return jnp.clip(self.icdf(u), self.a, self.b)
+
+
+class TruncatedNormal(TruncatedStandardNormal):
+    """loc/scale-transformed truncated normal (reference distribution.py:117-147)."""
+
+    def __init__(self, loc: jax.Array, scale: jax.Array, a: float = -1.0, b: float = 1.0):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        std_a = (a - self.loc) / self.scale
+        std_b = (b - self.loc) / self.scale
+        super().__init__(std_a, std_b)
+        self._raw_a, self._raw_b = a, b
+
+    def _to_std(self, value):
+        return (value - self.loc) / self.scale
+
+    def _from_std(self, value):
+        return value * self.scale + self.loc
+
+    @property
+    def mean(self):
+        return self._from_std(super().mean)
+
+    @property
+    def mode(self):
+        return jnp.clip(self.loc, self._raw_a, self._raw_b)
+
+    def entropy(self):
+        return super().entropy() + jnp.log(self.scale) * jnp.ones_like(self.loc)
+
+    def log_prob(self, value):
+        return super().log_prob(self._to_std(value)) - jnp.log(self.scale)
+
+    def sample(self, key, sample_shape=()):
+        return self._from_std(super().sample(key, sample_shape))
+
+    def cdf(self, value):
+        return super().cdf(self._to_std(value))
+
+    def icdf(self, value):
+        return self._from_std(super().icdf(value))
+
+
+class SymlogDistribution(Distribution):
+    """'Distribution' whose log_prob is -|symlog(x) - mode|^p (reference
+    distribution.py:152-193); used by the DV3 vector-obs decoder."""
+
+    def __init__(self, mode: jax.Array, dims: int = 1, dist: str = "mse", agg: str = "sum"):
+        self._mode = jnp.asarray(mode, jnp.float32)
+        self._dims = tuple(range(-dims, 0))
+        self._dist = dist
+        self._agg = agg
+
+    @property
+    def mode(self):
+        return symexp(self._mode)
+
+    @property
+    def mean(self):
+        return symexp(self._mode)
+
+    def log_prob(self, value):
+        assert len(self._mode.shape) == len(value.shape), (self._mode.shape, value.shape)
+        if self._dist == "mse":
+            distance = jnp.square(self._mode - symlog(value))
+        elif self._dist == "abs":
+            distance = jnp.abs(self._mode - symlog(value))
+        else:
+            raise NotImplementedError(self._dist)
+        if self._agg == "mean":
+            loss = jnp.mean(distance, axis=self._dims)
+        else:
+            loss = jnp.sum(distance, axis=self._dims)
+        return -loss
+
+    def sample(self, key, sample_shape=()):
+        return self.mode
+
+
+class MSEDistribution(Distribution):
+    """-MSE log_prob (reference distribution.py:196-221); DV3 image decoder."""
+
+    def __init__(self, mode: jax.Array, dims: int = 3, agg: str = "sum"):
+        self._mode = jnp.asarray(mode, jnp.float32)
+        self._dims = tuple(range(-dims, 0))
+        self._agg = agg
+
+    @property
+    def mode(self):
+        return self._mode
+
+    @property
+    def mean(self):
+        return self._mode
+
+    def log_prob(self, value):
+        distance = jnp.square(self._mode - value)
+        if self._agg == "mean":
+            loss = jnp.mean(distance, axis=self._dims)
+        else:
+            loss = jnp.sum(distance, axis=self._dims)
+        return -loss
+
+    def sample(self, key, sample_shape=()):
+        return self._mode
+
+
+class TwoHotEncodingDistribution(Distribution):
+    """Two-hot categorical over a symexp-spaced support (reference
+    distribution.py:224-276) — DV3 reward & critic heads.
+
+    `logits`: [..., bins]; log_prob(x) = sum(two_hot(symlog(x)) * log_softmax).
+    """
+
+    def __init__(self, logits: jax.Array, dims: int = 1, low: float = -20.0, high: float = 20.0):
+        self.logits = jnp.asarray(logits, jnp.float32)
+        self._dims = tuple(range(-dims, 0))
+        self.bins = jnp.asarray(symexp(jnp.linspace(low, high, self.logits.shape[-1])), jnp.float32)
+        self.low, self.high = low, high
+
+    @property
+    def probs(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    @property
+    def mean(self):
+        return jnp.sum(self.probs * self.bins, axis=-1, keepdims=True)
+
+    @property
+    def mode(self):
+        return self.mean
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        # two-hot encode x against self.bins (reference :253-269)
+        x = jnp.asarray(x, jnp.float32)
+        below = jnp.sum((self.bins <= x).astype(jnp.int32), axis=-1) - 1
+        above = self.logits.shape[-1] - jnp.sum((self.bins > x).astype(jnp.int32), axis=-1)
+        below = jnp.clip(below, 0, self.logits.shape[-1] - 1)
+        above = jnp.clip(above, 0, self.logits.shape[-1] - 1)
+        equal = below == above
+        dist_to_below = jnp.where(equal, 1.0, jnp.abs(self.bins[below] - x[..., 0]))
+        dist_to_above = jnp.where(equal, 1.0, jnp.abs(self.bins[above] - x[..., 0]))
+        total = dist_to_below + dist_to_above
+        w_below = dist_to_above / total
+        w_above = dist_to_below / total
+        nbins = self.logits.shape[-1]
+        target = (
+            jax.nn.one_hot(below, nbins) * w_below[..., None]
+            + jax.nn.one_hot(above, nbins) * w_above[..., None]
+        )
+        log_pred = self.logits - jax.scipy.special.logsumexp(self.logits, axis=-1, keepdims=True)
+        return jnp.sum(target * log_pred, axis=self._dims + (-1,) if len(self._dims) > 1 else -1)
+
+    def sample(self, key, sample_shape=()):
+        return self.mean
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> jax.Array:
+    """KL(p || q) for the pairs the Dreamer losses need."""
+    if isinstance(p, Independent) and isinstance(q, Independent):
+        return p._reduce(kl_divergence(p.base, q.base))
+    if isinstance(p, Independent):
+        return p._reduce(kl_divergence(p.base, q))
+    if isinstance(q, Independent):
+        return q._reduce(kl_divergence(p, q.base))
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = jnp.square(p.scale / q.scale)
+        t1 = jnp.square((p.loc - q.loc) / q.scale)
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        # covers OneHotCategorical subclasses: KL over the last axis
+        return jnp.sum(p.probs * (p.logits - q.logits), axis=-1)
+    raise NotImplementedError(f"KL not implemented for {type(p)} / {type(q)}")

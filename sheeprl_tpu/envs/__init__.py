@@ -1,0 +1,3 @@
+from .dummy import ContinuousDummyEnv, DiscreteDummyEnv, MultiDiscreteDummyEnv
+
+__all__ = ["ContinuousDummyEnv", "DiscreteDummyEnv", "MultiDiscreteDummyEnv"]

@@ -1,0 +1,84 @@
+"""Deterministic dummy envs — the test fake backend.
+
+Counterpart of reference sheeprl/envs/dummy.py:8-108: dict observations
+{rgb, state} with deterministic step-counter content, fixed-length episodes.
+Images are NHWC (TPU layout) unlike the reference's CHW.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+
+class BaseDummyEnv(gym.Env):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (64, 64, 3),
+        n_steps: int = 128,
+        vector_shape: Tuple[int, ...] = (10,),
+        dict_obs_space: bool = True,
+    ):
+        self._dict_obs_space = dict_obs_space
+        if dict_obs_space:
+            self.observation_space = gym.spaces.Dict(
+                {
+                    "rgb": gym.spaces.Box(0, 255, shape=image_size, dtype=np.uint8),
+                    "state": gym.spaces.Box(-20, 20, shape=vector_shape, dtype=np.float32),
+                }
+            )
+        else:
+            self.observation_space = gym.spaces.Box(-20, 20, shape=vector_shape, dtype=np.float32)
+        self.reward_range = (-np.inf, np.inf)
+        self._current_step = 0
+        self._n_steps = n_steps
+        self.render_mode = "rgb_array"
+
+    def get_obs(self) -> Any:
+        if self._dict_obs_space:
+            return {
+                "rgb": np.full(
+                    self.observation_space["rgb"].shape, self._current_step % 256, dtype=np.uint8
+                ),
+                "state": np.full(
+                    self.observation_space["state"].shape, self._current_step, dtype=np.float32
+                ),
+            }
+        return np.full(self.observation_space.shape, self._current_step, dtype=np.float32)
+
+    def step(self, action: Any):
+        done = self._current_step == self._n_steps
+        self._current_step += 1
+        return self.get_obs(), 0.0, done, False, {}
+
+    def reset(self, seed: Optional[int] = None, options: Optional[dict] = None):
+        super().reset(seed=seed)
+        self._current_step = 0
+        return self.get_obs(), {}
+
+    def render(self):
+        if self._dict_obs_space:
+            return self.get_obs()["rgb"]
+        return np.zeros((64, 64, 3), dtype=np.uint8)
+
+    def close(self):
+        pass
+
+
+class ContinuousDummyEnv(BaseDummyEnv):
+    def __init__(self, action_dim: int = 2, **kwargs: Any):
+        self.action_space = gym.spaces.Box(-1.0, 1.0, shape=(action_dim,), dtype=np.float32)
+        super().__init__(**kwargs)
+
+
+class DiscreteDummyEnv(BaseDummyEnv):
+    def __init__(self, action_dim: int = 2, n_steps: int = 4, **kwargs: Any):
+        self.action_space = gym.spaces.Discrete(action_dim)
+        super().__init__(n_steps=n_steps, **kwargs)
+
+
+class MultiDiscreteDummyEnv(BaseDummyEnv):
+    def __init__(self, action_dims: Optional[List[int]] = None, **kwargs: Any):
+        self.action_space = gym.spaces.MultiDiscrete(action_dims or [2, 2])
+        super().__init__(**kwargs)

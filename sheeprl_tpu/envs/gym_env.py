@@ -1,0 +1,15 @@
+"""Default env wrapper target: plain `gym.make` (the analogue of the
+reference's `configs/env/default.yaml` wrapper `_target_: gymnasium.make`)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import gymnasium as gym
+
+
+def make_gym_env(id: str, render_mode: Optional[str] = "rgb_array", **kwargs: Any) -> gym.Env:
+    try:
+        return gym.make(id, render_mode=render_mode, **kwargs)
+    except Exception:
+        # some envs don't accept render_mode
+        return gym.make(id, **kwargs)

@@ -1,0 +1,308 @@
+"""Generic gymnasium wrappers (host-side, semantics ported 1:1).
+
+Reference: sheeprl/envs/wrappers.py — `MaskVelocityWrapper` (:13),
+`ActionRepeat` (:48), `RestartOnException` (:74, the env fault-tolerance
+mechanism), `FrameStack` (dilated, :126), `RewardAsObservationWrapper` (:185),
+`GrayscaleRenderWrapper` (:244), `ActionsAsObservationWrapper` (:258).
+
+Image observations are NHWC (TPU-native) throughout.
+"""
+from __future__ import annotations
+
+import copy
+import time
+from collections import deque
+from typing import Any, Dict, Optional, Sequence, SupportsFloat, Tuple
+
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+
+class MaskVelocityWrapper(gym.ObservationWrapper):
+    """Zero out velocity entries of classic-control observations
+    (reference wrappers.py:13-45)."""
+
+    velocity_indices: Dict[str, np.ndarray] = {
+        "CartPole-v0": np.array([1, 3]),
+        "CartPole-v1": np.array([1, 3]),
+        "MountainCar-v0": np.array([1]),
+        "MountainCarContinuous-v0": np.array([1]),
+        "Pendulum-v1": np.array([2]),
+        "LunarLander-v2": np.array([2, 3, 5]),
+        "LunarLanderContinuous-v2": np.array([2, 3, 5]),
+    }
+
+    def __init__(self, env: gym.Env):
+        super().__init__(env)
+        env_id = env.unwrapped.spec.id if env.unwrapped.spec is not None else ""
+        if env_id not in self.velocity_indices:
+            raise NotImplementedError(f"Velocity masking not implemented for {env_id}")
+        self.mask = np.ones(env.observation_space.shape, dtype=np.float32)
+        self.mask[self.velocity_indices[env_id]] = 0.0
+
+    def observation(self, observation: np.ndarray) -> np.ndarray:
+        return observation * self.mask
+
+
+class ActionRepeat(gym.Wrapper):
+    """Repeat each action `amount` times, summing rewards (reference :48-71)."""
+
+    def __init__(self, env: gym.Env, amount: int = 1):
+        super().__init__(env)
+        if amount <= 0:
+            raise ValueError("`amount` should be a positive integer")
+        self._amount = int(amount)
+
+    @property
+    def action_repeat(self) -> int:
+        return self._amount
+
+    def step(self, action: Any) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        done = False
+        truncated = False
+        current_step = 0
+        total_reward = 0.0
+        obs, info = None, {}
+        while current_step < self._amount and not (done or truncated):
+            obs, reward, done, truncated, info = self.env.step(action)
+            total_reward += float(reward)
+            current_step += 1
+        return obs, total_reward, done, truncated, info
+
+
+class RestartOnException(gym.Wrapper):
+    """Re-create a crashed env, with a failure budget inside a sliding window
+    (reference wrappers.py:74-123) — used because MineRL/Diambra crash in
+    practice. On step failure, returns a zeroed obs with truncated=True and
+    `info["restart_on_exception"]=True` so train loops can patch buffers
+    (reference dreamer_v3.py:595-608)."""
+
+    def __init__(self, env_fn, exceptions: Tuple = (Exception,), window: float = 300.0, maxfails: int = 2):
+        self._env_fn = env_fn
+        self._exceptions = exceptions
+        self._window = window
+        self._maxfails = maxfails
+        self._fails = 0
+        self._last_fail_time = 0.0
+        super().__init__(env_fn())
+
+    def _restart(self) -> None:
+        now = time.time()
+        if now - self._last_fail_time < self._window:
+            self._fails += 1
+        else:
+            self._fails = 1
+        self._last_fail_time = now
+        if self._fails > self._maxfails:
+            raise RuntimeError(f"Env crashed too many times ({self._fails} in {self._window}s)")
+        try:
+            self.env.close()
+        except Exception:
+            pass
+        self.env = self._env_fn()
+
+    def reset(self, **kwargs: Any):
+        for _ in range(self._maxfails + 1):
+            try:
+                return self.env.reset(**kwargs)
+            except self._exceptions:
+                self._restart()
+        raise RuntimeError("Unreachable")
+
+    def step(self, action: Any):
+        try:
+            return self.env.step(action)
+        except self._exceptions:
+            self._restart()
+            obs, info = self.env.reset()
+            info = dict(info)
+            info["restart_on_exception"] = True
+            return obs, 0.0, False, True, info
+
+
+class FrameStack(gym.Wrapper):
+    """Stack the last `num_stack` frames of every CNN key, with optional
+    dilation (reference wrappers.py:126-182). Output key shape:
+    [H, W, C*num_stack] (NHWC; the reference stacks on the channel axis of
+    NCHW — same information, TPU layout)."""
+
+    def __init__(self, env: gym.Env, num_stack: int, cnn_keys: Sequence[str], dilation: int = 1):
+        super().__init__(env)
+        if num_stack <= 0:
+            raise ValueError(f"Invalid value for num_stack: {num_stack}")
+        if not isinstance(env.observation_space, spaces.Dict):
+            raise RuntimeError(f"FrameStack requires dict observations, got {type(env.observation_space)}")
+        self._num_stack = num_stack
+        self._dilation = dilation
+        self._cnn_keys = [
+            k
+            for k in (cnn_keys or [])
+            if k in env.observation_space.spaces and len(env.observation_space[k].shape) == 3
+        ]
+        if not self._cnn_keys:
+            raise RuntimeError(f"Specify at least one valid cnn key for frame stacking: {cnn_keys}")
+        self._frames: Dict[str, deque] = {
+            k: deque(maxlen=num_stack * dilation) for k in self._cnn_keys
+        }
+        new_spaces = dict(env.observation_space.spaces)
+        for k in self._cnn_keys:
+            sp = env.observation_space[k]
+            h, w, c = sp.shape
+            low = np.repeat(sp.low, num_stack, axis=-1) if np.ndim(sp.low) else sp.low
+            high = np.repeat(sp.high, num_stack, axis=-1) if np.ndim(sp.high) else sp.high
+            new_spaces[k] = spaces.Box(
+                low if np.ndim(low) else float(low),
+                high if np.ndim(high) else float(high),
+                (h, w, c * num_stack),
+                sp.dtype,
+            )
+        self.observation_space = spaces.Dict(new_spaces)
+
+    def _get_obs(self, obs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        out = dict(obs)
+        for k in self._cnn_keys:
+            # dilation-1 offset keeps the newest frame in the stack
+            # (reference wrappers.py:178 `[dilation-1::dilation]`)
+            frames = list(self._frames[k])[self._dilation - 1 :: self._dilation][-self._num_stack :]
+            out[k] = np.concatenate(frames, axis=-1)
+        return out
+
+    def reset(self, **kwargs: Any):
+        obs, info = self.env.reset(**kwargs)
+        for k in self._cnn_keys:
+            self._frames[k].clear()
+            for _ in range(self._num_stack * self._dilation):
+                self._frames[k].append(obs[k])
+        return self._get_obs(obs), info
+
+    def step(self, action: Any):
+        obs, reward, done, truncated, info = self.env.step(action)
+        for k in self._cnn_keys:
+            self._frames[k].append(obs[k])
+        return self._get_obs(obs), reward, done, truncated, info
+
+
+class RewardAsObservationWrapper(gym.Wrapper):
+    """Expose the last reward under obs key 'reward' (reference :185-241)."""
+
+    def __init__(self, env: gym.Env):
+        super().__init__(env)
+        reward_space = spaces.Box(-np.inf, np.inf, (1,), np.float32)
+        if isinstance(env.observation_space, spaces.Dict):
+            new_spaces = dict(env.observation_space.spaces)
+            new_spaces["reward"] = reward_space
+            self.observation_space = spaces.Dict(new_spaces)
+        else:
+            self.observation_space = spaces.Dict(
+                {"obs": env.observation_space, "reward": reward_space}
+            )
+
+    def _wrap(self, obs: Any, reward: float) -> Dict[str, Any]:
+        r = np.array([reward], dtype=np.float32)
+        if isinstance(obs, dict):
+            return {**obs, "reward": r}
+        return {"obs": obs, "reward": r}
+
+    def reset(self, **kwargs: Any):
+        obs, info = self.env.reset(**kwargs)
+        return self._wrap(obs, 0.0), info
+
+    def step(self, action: Any):
+        obs, reward, done, truncated, info = self.env.step(action)
+        return self._wrap(obs, float(reward)), reward, done, truncated, info
+
+
+class ActionsAsObservationWrapper(gym.Wrapper):
+    """Expose the last `num_stack` actions under obs key 'action'
+    (reference wrappers.py:258-342). `noop` defines the filler action used at
+    reset; dilation subsamples the action history."""
+
+    def __init__(self, env: gym.Env, num_stack: int, noop: Any, dilation: int = 1):
+        super().__init__(env)
+        if num_stack < 1:
+            raise ValueError(f"The number of stacked actions must be greater than zero, got: {num_stack}")
+        if dilation < 1:
+            raise ValueError(f"The dilation must be greater than zero, got: {dilation}")
+        self._num_stack = num_stack
+        self._dilation = dilation
+        act_space = env.action_space
+        if isinstance(act_space, spaces.Discrete):
+            if not isinstance(noop, int):
+                raise ValueError(f"The noop action must be an integer for discrete action spaces, got: {noop}")
+            self._per_action = int(act_space.n)
+            self._noop = np.zeros(self._per_action, dtype=np.float32)
+            self._noop[noop] = 1.0
+        elif isinstance(act_space, spaces.MultiDiscrete):
+            if not isinstance(noop, (list, tuple)):
+                raise ValueError(f"The noop action must be a list for multi-discrete action spaces, got: {noop}")
+            nvec = act_space.nvec
+            if len(noop) != len(nvec):
+                raise ValueError(f"The noop action must have {len(nvec)} entries, got: {len(noop)}")
+            self._per_action = int(sum(nvec))
+            oh = []
+            for n, a in zip(nvec, noop):
+                v = np.zeros(int(n), dtype=np.float32)
+                v[int(a)] = 1.0
+                oh.append(v)
+            self._noop = np.concatenate(oh)
+        elif isinstance(act_space, spaces.Box):
+            if not isinstance(noop, float):
+                raise ValueError(f"The noop action must be a float for continuous action spaces, got: {noop}")
+            self._per_action = int(np.prod(act_space.shape))
+            self._noop = np.full(self._per_action, noop, dtype=np.float32)
+        else:
+            raise RuntimeError(f"Unsupported action space for ActionsAsObservation: {type(act_space)}")
+        self._actions: deque = deque(maxlen=num_stack * dilation)
+        obs_spaces = (
+            dict(env.observation_space.spaces)
+            if isinstance(env.observation_space, spaces.Dict)
+            else {"obs": env.observation_space}
+        )
+        obs_spaces["action"] = spaces.Box(-np.inf, np.inf, (self._per_action * num_stack,), np.float32)
+        self.observation_space = spaces.Dict(obs_spaces)
+
+    def _action_vec(self, action: Any) -> np.ndarray:
+        act_space = self.env.action_space
+        if isinstance(act_space, spaces.Discrete):
+            v = np.zeros(self._per_action, dtype=np.float32)
+            v[int(np.asarray(action).reshape(()))] = 1.0
+            return v
+        if isinstance(act_space, spaces.MultiDiscrete):
+            oh = []
+            for n, a in zip(act_space.nvec, np.asarray(action).reshape(-1)):
+                x = np.zeros(int(n), dtype=np.float32)
+                x[int(a)] = 1.0
+                oh.append(x)
+            return np.concatenate(oh)
+        return np.asarray(action, dtype=np.float32).reshape(-1)
+
+    def _obs(self, obs: Any) -> Dict[str, Any]:
+        stacked = list(self._actions)[self._dilation - 1 :: self._dilation][-self._num_stack :]
+        action_obs = np.concatenate(stacked).astype(np.float32)
+        if isinstance(obs, dict):
+            return {**obs, "action": action_obs}
+        return {"obs": obs, "action": action_obs}
+
+    def reset(self, **kwargs: Any):
+        obs, info = self.env.reset(**kwargs)
+        self._actions.clear()
+        for _ in range(self._num_stack * self._dilation):
+            self._actions.append(self._noop)
+        return self._obs(obs), info
+
+    def step(self, action: Any):
+        obs, reward, done, truncated, info = self.env.step(action)
+        self._actions.append(self._action_vec(action))
+        return self._obs(obs), reward, done, truncated, info
+
+
+class GrayscaleRenderWrapper(gym.Wrapper):
+    """Make `render()` return grayscale frames (reference :244-255)."""
+
+    def render(self):
+        frame = self.env.render()
+        if frame is not None and frame.ndim == 3 and frame.shape[-1] == 3:
+            frame = np.expand_dims(frame.mean(-1).astype(frame.dtype), axis=-1)
+            frame = np.repeat(frame, 3, axis=-1)
+        return frame

@@ -1,0 +1,29 @@
+from .models import (
+    CNN,
+    DeCNN,
+    LayerNorm,
+    LayerNormChannelLast,
+    LayerNormGRUCell,
+    MLP,
+    MultiDecoder,
+    MultiEncoder,
+    NatureCNN,
+    get_activation,
+    hafner_uniform_init,
+    orthogonal_init,
+)
+
+__all__ = [
+    "CNN",
+    "DeCNN",
+    "LayerNorm",
+    "LayerNormChannelLast",
+    "LayerNormGRUCell",
+    "MLP",
+    "MultiDecoder",
+    "MultiEncoder",
+    "NatureCNN",
+    "get_activation",
+    "hafner_uniform_init",
+    "orthogonal_init",
+]

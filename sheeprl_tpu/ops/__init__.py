@@ -1,0 +1,12 @@
+from .transforms import symlog, symexp, two_hot_encoder, two_hot_decoder
+from .returns import gae, lambda_values, nstep_returns
+
+__all__ = [
+    "symlog",
+    "symexp",
+    "two_hot_encoder",
+    "two_hot_decoder",
+    "gae",
+    "lambda_values",
+    "nstep_returns",
+]

@@ -1,0 +1,84 @@
+"""Return estimators as reverse `lax.scan`s.
+
+The reference computes GAE (sheeprl/utils/utils.py:63-100) and Dreamer
+lambda-values (dreamer_v3/utils.py:66-77) with reversed Python loops; on TPU
+both are reverse scans compiled into a single fused loop.
+
+Time axis is axis 0 throughout ([T, B, ...] layout).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def gae(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    next_value: jax.Array,
+    num_steps: int,
+    gamma: float,
+    gae_lambda: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Generalized advantage estimation (reference utils.py:63-100).
+
+    Args shaped [T, B, 1] (rewards/values/dones), next_value [B, 1].
+    Returns (returns, advantages), both [T, B, 1]. `dones[t]` marks episode
+    termination *at* step t (not-done convention matches the reference:
+    `not_done = 1 - dones`, bootstrapping with next_value after the last step).
+    """
+    del num_steps
+    not_dones = 1.0 - dones
+    next_values = jnp.concatenate([values[1:], next_value[None]], axis=0)
+    deltas = rewards + gamma * next_values * not_dones - values
+
+    def step(carry, xs):
+        delta, nd = xs
+        adv = delta + gamma * gae_lambda * nd * carry
+        return adv, adv
+
+    _, advantages = jax.lax.scan(
+        step, jnp.zeros_like(next_value), (deltas, not_dones), reverse=True
+    )
+    return advantages + values, advantages
+
+
+def lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """Dreamer TD(λ) targets (reference dreamer_v3/utils.py:66-77).
+
+    rewards/values/continues: [T, B, 1] where `continues` already includes the
+    discount factor γ. Returns T λ-targets R_0..R_{T-1}; the recursion
+    bootstraps from values[-1] (R_{T-1} = interm[T-1] + c_{T-1}·λ·values[-1]).
+    """
+    interm = rewards + continues * values * (1 - lmbda)
+
+    def step(carry, xs):
+        ri, ci = xs
+        lv = ri + ci * lmbda * carry
+        return lv, lv
+
+    _, lvs = jax.lax.scan(step, values[-1], (interm, continues), reverse=True)
+    return lvs
+
+
+def nstep_returns(
+    rewards: jax.Array, values: jax.Array, dones: jax.Array, gamma: float
+) -> jax.Array:
+    """Simple discounted bootstrap returns (A2C path)."""
+    not_dones = 1.0 - dones
+
+    def step(carry, xs):
+        r, nd = xs
+        ret = r + gamma * nd * carry
+        return ret, ret
+
+    _, rets = jax.lax.scan(step, values[-1], (rewards, not_dones), reverse=True)
+    return rets

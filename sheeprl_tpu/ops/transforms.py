@@ -1,0 +1,48 @@
+"""Elementwise numeric transforms: symlog/symexp, two-hot encoding.
+
+Reference: sheeprl/utils/utils.py:148-205 (`symlog`, `symexp`,
+`two_hot_encoder`, `two_hot_decoder`). Pure jnp — XLA fuses these into the
+surrounding matmuls; no kernel needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def symlog(x: jax.Array) -> jax.Array:
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x: jax.Array) -> jax.Array:
+    return jnp.sign(x) * (jnp.expm1(jnp.abs(x)))
+
+
+def two_hot_encoder(x: jax.Array, support_range: int = 300, num_buckets: int = 255) -> jax.Array:
+    """Two-hot encode scalars onto a symexp-spaced support of `num_buckets` bins.
+
+    Matches reference utils.py:159-184: support = symexp(linspace(-20, 20)) is
+    replaced in the reference by linspace over [-support_range, support_range]
+    in symlog space; values land fractionally between the two nearest bins.
+    Input [..., 1] → output [..., num_buckets].
+    """
+    x = symlog(x)
+    support = jnp.linspace(-support_range, support_range, num_buckets)
+    x = jnp.clip(x, -support_range, support_range)
+    idx_low = jnp.sum(support[None, :] <= x[..., :], axis=-1) - 1
+    idx_low = jnp.clip(idx_low, 0, num_buckets - 1)
+    idx_high = jnp.clip(idx_low + 1, 0, num_buckets - 1)
+    low_val = support[idx_low]
+    high_val = support[idx_high]
+    denom = high_val - low_val
+    frac = jnp.where(denom > 0, (x[..., 0] - low_val) / jnp.where(denom > 0, denom, 1.0), 0.0)
+    oh_low = jax.nn.one_hot(idx_low, num_buckets) * (1.0 - frac)[..., None]
+    oh_high = jax.nn.one_hot(idx_high, num_buckets) * frac[..., None]
+    return oh_low + oh_high
+
+
+def two_hot_decoder(probs: jax.Array, support_range: int = 300) -> jax.Array:
+    """Decode a two-hot distribution back to a scalar (reference utils.py:187-205)."""
+    num_buckets = probs.shape[-1]
+    support = jnp.linspace(-support_range, support_range, num_buckets)
+    return symexp(jnp.sum(probs * support, axis=-1, keepdims=True))

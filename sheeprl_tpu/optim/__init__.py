@@ -1,0 +1,115 @@
+"""Optimizers as optax gradient transformations.
+
+Factory functions keyed by the reference's torch optimizer configs
+(configs/optim/*.yaml): `adam`, `sgd`, `rmsprop`, and `rmsprop_tf` — the
+TF-style RMSprop DreamerV1/V2 use (reference sheeprl/optim/rmsprop_tf.py:
+14-156: eps added *inside* the sqrt, square_avg initialized to ones, lr
+folded into the momentum buffer). Each factory returns an
+`optax.GradientTransformation`; `max_grad_norm` clipping is composed by the
+algorithms via `clipped`.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def adam(
+    lr: float = 1e-3,
+    eps: float = 1e-8,
+    betas: Sequence[float] = (0.9, 0.999),
+    weight_decay: float = 0.0,
+    **_: Any,
+) -> optax.GradientTransformation:
+    if weight_decay:
+        return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay)
+    return optax.adam(lr, b1=betas[0], b2=betas[1], eps=eps)
+
+
+def sgd(
+    lr: float = 1e-2,
+    momentum: float = 0.0,
+    nesterov: bool = False,
+    weight_decay: float = 0.0,
+    **_: Any,
+) -> optax.GradientTransformation:
+    tx = optax.sgd(lr, momentum=momentum or None, nesterov=nesterov)
+    if weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+def rmsprop(
+    lr: float = 1e-2,
+    alpha: float = 0.99,
+    eps: float = 1e-8,
+    momentum: float = 0.0,
+    centered: bool = False,
+    **_: Any,
+) -> optax.GradientTransformation:
+    return optax.rmsprop(
+        lr, decay=alpha, eps=eps, momentum=momentum or None, centered=centered
+    )
+
+
+class RMSpropTFState(NamedTuple):
+    square_avg: Any
+    momentum_buf: Any
+    grad_avg: Any
+
+
+def rmsprop_tf(
+    lr: float = 1e-2,
+    alpha: float = 0.99,
+    eps: float = 1e-8,
+    momentum: float = 0.0,
+    centered: bool = False,
+    **_: Any,
+) -> optax.GradientTransformation:
+    """TF/Hafner-style RMSprop (reference rmsprop_tf.py:14-156).
+
+    Differences from torch/optax rmsprop: square_avg starts at **1.0** (not
+    0), and eps is inside the sqrt: update = g / sqrt(avg + eps). With
+    momentum, the learning rate multiplies the update *before* entering the
+    momentum buffer.
+    """
+
+    def init(params):
+        return RMSpropTFState(
+            square_avg=jax.tree.map(jnp.ones_like, params),
+            momentum_buf=jax.tree.map(jnp.zeros_like, params) if momentum else None,
+            grad_avg=jax.tree.map(jnp.zeros_like, params) if centered else None,
+        )
+
+    def update(grads, state, params=None):
+        del params
+        sq = jax.tree.map(
+            lambda s, g: alpha * s + (1 - alpha) * jnp.square(g), state.square_avg, grads
+        )
+        if centered:
+            ga = jax.tree.map(lambda a, g: alpha * a + (1 - alpha) * g, state.grad_avg, grads)
+            denom = jax.tree.map(lambda s, a: jnp.sqrt(s - jnp.square(a) + eps), sq, ga)
+        else:
+            ga = None
+            denom = jax.tree.map(lambda s: jnp.sqrt(s + eps), sq)
+        scaled = jax.tree.map(lambda g, d: lr * g / d, grads, denom)
+        if momentum:
+            buf = jax.tree.map(lambda b, u: momentum * b + u, state.momentum_buf, scaled)
+            updates = jax.tree.map(lambda b: -b, buf)
+        else:
+            buf = None
+            updates = jax.tree.map(lambda u: -u, scaled)
+        return updates, RMSpropTFState(square_avg=sq, momentum_buf=buf, grad_avg=ga)
+
+    return optax.GradientTransformation(init, update)
+
+
+def clipped(tx: optax.GradientTransformation, max_grad_norm: Optional[float]) -> optax.GradientTransformation:
+    """Compose global-norm clipping in front of an optimizer (the analogue of
+    `fabric.clip_gradients` in every reference train fn)."""
+    if max_grad_norm and max_grad_norm > 0:
+        return optax.chain(optax.clip_by_global_norm(max_grad_norm), tx)
+    return tx

@@ -1,0 +1,3 @@
+from .mesh import Distributed, Precision, build_distributed, get_precision
+
+__all__ = ["Distributed", "Precision", "build_distributed", "get_precision"]

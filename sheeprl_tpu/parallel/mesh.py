@@ -1,0 +1,184 @@
+"""Device-mesh launcher — the TPU-native replacement for Lightning Fabric.
+
+The reference wraps torch.distributed in Fabric (reference
+configs/fabric/default.yaml, cli.py:149-199): `launch` spawns one process per
+device, `setup_module` wraps modules in DDP, `backward` all-reduces grads over
+NCCL/Gloo. On TPU none of that exists as separate machinery: JAX is
+single-controller per host, and data parallelism is expressed as *sharding* —
+params replicated over a 1-D ``dp`` mesh, batches sharded on the leading axis,
+and XLA emits the psum for gradient averaging inside the jitted train step.
+
+`Distributed` owns:
+* `jax.distributed.initialize` for multi-host (DCN) runs
+* the `jax.sharding.Mesh` (1-D ``dp`` for parity; extra axes reserved for
+  tp/sp extensions)
+* sharding helpers (`shard_batch`, `replicate`) and precision policy
+* seeding (`seed_everything` → a root `jax.random.key`)
+
+There is no "player vs trainer module" duality (reference ppo/agent.py:278-298
+tied-weights pattern): inference reuses the same pure apply fn with the
+current params pytree.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import Config
+
+_PRECISION_POLICIES = {
+    # name: (param_dtype, compute_dtype)
+    "32-true": (jnp.float32, jnp.float32),
+    "bf16-mixed": (jnp.float32, jnp.bfloat16),
+    "bf16-true": (jnp.bfloat16, jnp.bfloat16),
+    "16-mixed": (jnp.float32, jnp.float16),
+}
+
+
+@dataclass
+class Precision:
+    name: str
+    param_dtype: Any
+    compute_dtype: Any
+
+
+def get_precision(name: str) -> Precision:
+    if name not in _PRECISION_POLICIES:
+        raise ValueError(f"Unknown precision '{name}'. Options: {sorted(_PRECISION_POLICIES)}")
+    p, c = _PRECISION_POLICIES[name]
+    return Precision(name, p, c)
+
+
+class Distributed:
+    """Mesh + sharding + precision context threaded through every algorithm."""
+
+    def __init__(
+        self,
+        devices: Any = 1,
+        accelerator: str = "auto",
+        precision: str = "32-true",
+        num_nodes: int = 1,
+        strategy: str = "auto",
+        mesh_axes: Sequence[str] = ("dp",),
+        mesh_shape: Optional[Sequence[int]] = None,
+    ):
+        del strategy  # parity knob; sharding subsumes DDP/single-device
+        # Multi-host initialization (DCN): driven by standard JAX env vars /
+        # TPU metadata; only attempt when explicitly configured.
+        if num_nodes > 1 and not jax.distributed.is_initialized():
+            jax.distributed.initialize()
+
+        if accelerator in ("auto", None):
+            backend = None
+        elif accelerator in ("tpu", "gpu", "cuda", "cpu"):
+            backend = {"cuda": "gpu"}.get(accelerator, accelerator)
+        else:
+            raise ValueError(f"Unknown accelerator '{accelerator}'")
+        try:
+            all_devices = jax.devices(backend) if backend else jax.devices()
+        except RuntimeError:
+            all_devices = jax.devices()
+
+        if devices in ("auto", -1, "-1", None):
+            n = len(all_devices)
+        else:
+            n = int(devices)
+        if n > len(all_devices):
+            raise RuntimeError(
+                f"Requested {n} devices but only {len(all_devices)} available "
+                f"({[d.platform for d in all_devices[:4]]}...)"
+            )
+        self.devices = all_devices[:n]
+        self.num_nodes = num_nodes
+
+        axes = tuple(mesh_axes)
+        if mesh_shape is None:
+            mesh_shape = (n,) + (1,) * (len(axes) - 1)
+        dev_array = np.asarray(self.devices).reshape(tuple(mesh_shape))
+        self.mesh = Mesh(dev_array, axes)
+        self.precision = get_precision(precision)
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def world_size(self) -> int:
+        return len(self.devices)
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def is_global_zero(self) -> bool:
+        return self.process_index == 0
+
+    @property
+    def local_device(self) -> Any:
+        return self.devices[0]
+
+    # -- shardings ---------------------------------------------------------
+    def sharding(self, *spec: Any) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return self.sharding()
+
+    @property
+    def batch_sharding(self) -> NamedSharding:
+        """Leading-axis sharding over the dp axis — the DP data layout."""
+        return self.sharding("dp")
+
+    def shard_batch(self, tree: Any) -> Any:
+        """Move a host batch to devices, sharded on the leading axis."""
+        s = self.batch_sharding
+        return jax.tree.map(lambda x: jax.device_put(x, s), tree)
+
+    def replicate(self, tree: Any) -> Any:
+        s = self.replicated
+        return jax.tree.map(lambda x: jax.device_put(x, s), tree)
+
+    def to_host(self, tree: Any) -> Any:
+        return jax.device_get(tree)
+
+    # -- seeding -----------------------------------------------------------
+    def seed_everything(self, seed: int) -> jax.Array:
+        """Root PRNG key + numpy/python seeding (reference cli.py:187-197)."""
+        import random
+
+        random.seed(seed)
+        np.random.seed(seed)
+        os.environ.setdefault("PYTHONHASHSEED", str(seed))
+        return jax.random.key(seed)
+
+    # -- dtype policy ------------------------------------------------------
+    def cast_compute(self, tree: Any) -> Any:
+        c = self.precision.compute_dtype
+        return jax.tree.map(
+            lambda x: x.astype(c) if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+    def cast_params(self, tree: Any) -> Any:
+        p = self.precision.param_dtype
+        return jax.tree.map(
+            lambda x: x.astype(p) if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+
+def build_distributed(cfg: Config) -> Distributed:
+    """Build from `cfg.fabric` (group name kept for reference parity)."""
+    fab = cfg.get("fabric", Config())
+    return Distributed(
+        devices=fab.get("devices", 1),
+        accelerator=fab.get("accelerator", "auto"),
+        precision=str(fab.get("precision", "32-true")),
+        num_nodes=int(fab.get("num_nodes", 1)),
+        strategy=fab.get("strategy", "auto"),
+    )

@@ -1,0 +1,13 @@
+from .registry import (
+    algorithm_registry,
+    evaluation_registry,
+    register_algorithm,
+    register_evaluation,
+)
+
+__all__ = [
+    "algorithm_registry",
+    "evaluation_registry",
+    "register_algorithm",
+    "register_evaluation",
+]

@@ -1,0 +1,93 @@
+"""Checkpoint write/prune/restore.
+
+Replaces the reference's Fabric-save + `CheckpointCallback`
+(sheeprl/utils/callback.py:14-148): state = params/opt-state pytrees +
+counters + algorithm extras (+ optionally the whole replay buffer), written
+atomically with `keep_last` pruning, with the resolved config saved beside the
+checkpoints (reference utils.py:255-257). Pytrees are devices→host converted
+(numpy) and pickled; PRNG keys are carried as their uint32 key data so resume
+is fully reproducible.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+def _to_host(tree: Any) -> Any:
+    def conv(x: Any) -> Any:
+        if isinstance(x, jax.Array):
+            if jnp_is_key(x):
+                return {"__prng_key__": np.asarray(jax.random.key_data(x))}
+            return np.asarray(jax.device_get(x))
+        return x
+
+    return jax.tree.map(conv, tree)
+
+
+def _from_host(tree: Any) -> Any:
+    def conv(x: Any) -> Any:
+        if isinstance(x, dict) and set(x) == {"__prng_key__"}:
+            return jax.random.wrap_key_data(jax.numpy.asarray(x["__prng_key__"]))
+        return x
+
+    return jax.tree.map(conv, tree, is_leaf=lambda x: isinstance(x, dict) and set(x) == {"__prng_key__"})
+
+
+def jnp_is_key(x: Any) -> bool:
+    try:
+        return jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key)
+    except Exception:
+        return False
+
+
+class CheckpointManager:
+    """Writes `ckpt_{policy_step}.ckpt` under `<log_dir>/checkpoint`."""
+
+    def __init__(self, log_dir: str, keep_last: Optional[int] = None, enabled: bool = True):
+        self.dir = Path(log_dir) / "checkpoint"
+        self.keep_last = keep_last
+        self.enabled = enabled
+        if enabled:
+            self.dir.mkdir(parents=True, exist_ok=True)
+
+    def save(self, step: int, state: Dict[str, Any]) -> Optional[str]:
+        if not self.enabled:
+            return None
+        path = self.dir / f"ckpt_{step}.ckpt"
+        tmp = path.with_suffix(".tmp")
+        payload = _to_host(state)
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+        self._prune()
+        return str(path)
+
+    def _prune(self) -> None:
+        if not self.keep_last:
+            return
+        ckpts = self.list_checkpoints()
+        for old in ckpts[: -self.keep_last]:
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+
+    def list_checkpoints(self) -> List[Path]:
+        if not self.dir.is_dir():
+            return []
+        return sorted(
+            (p for p in self.dir.iterdir() if p.suffix == ".ckpt"),
+            key=lambda p: int(p.stem.split("_")[1]),
+        )
+
+    @staticmethod
+    def load(path: os.PathLike) -> Dict[str, Any]:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        return _from_host(payload)

@@ -1,0 +1,269 @@
+"""`make_env` — the env construction pipeline.
+
+Ports the reference factory semantics (sheeprl/utils/env.py:26-231):
+instantiate `cfg.env.wrapper` → ActionRepeat → MaskVelocity → dict-obs
+normalization (vector-only / pixel-only envs are lifted into Dict spaces keyed
+by the first requested mlp/cnn key) → resize/grayscale → FrameStack →
+ActionsAsObservation → RewardAsObservation → seeding → TimeLimit →
+RecordEpisodeStatistics → RecordVideo.
+
+Divergence from the reference: images stay **channel-last (NHWC)** — the TPU
+conv layout — instead of being transposed to CHW for torch.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Callable, Dict, Optional
+
+import gymnasium as gym
+import numpy as np
+
+from ..config import Config, instantiate
+from ..envs.wrappers import (
+    ActionRepeat,
+    ActionsAsObservationWrapper,
+    FrameStack,
+    GrayscaleRenderWrapper,
+    MaskVelocityWrapper,
+    RewardAsObservationWrapper,
+)
+
+
+class _DictObs(gym.ObservationWrapper):
+    """Lift a Box observation into a single-key Dict observation."""
+
+    def __init__(self, env: gym.Env, key: str):
+        super().__init__(env)
+        self._key = key
+        self.observation_space = gym.spaces.Dict({key: env.observation_space})
+
+    def observation(self, observation: Any) -> Dict[str, Any]:
+        return {self._key: observation}
+
+
+class _RenderObs(gym.Wrapper):
+    """Add a rendered-pixels key to the observation (PixelObservationWrapper
+    replacement for envs with vector-only state)."""
+
+    def __init__(self, env: gym.Env, pixel_key: str, state_key: Optional[str]):
+        super().__init__(env)
+        self._pixel_key = pixel_key
+        self._state_key = state_key
+        frame = self._render_frame()
+        spaces: Dict[str, gym.Space] = {
+            pixel_key: gym.spaces.Box(0, 255, frame.shape, np.uint8)
+        }
+        if state_key is not None:
+            spaces[state_key] = env.observation_space
+        self.observation_space = gym.spaces.Dict(spaces)
+
+    def _render_frame(self) -> np.ndarray:
+        frame = self.env.render()
+        if frame is None:
+            raise RuntimeError(
+                "Pixel observations requested but the env does not render rgb_array frames"
+            )
+        return np.asarray(frame, dtype=np.uint8)
+
+    def _obs(self, obs: Any) -> Dict[str, Any]:
+        out = {self._pixel_key: self._render_frame()}
+        if self._state_key is not None:
+            out[self._state_key] = obs
+        return out
+
+    def reset(self, **kwargs: Any):
+        obs, info = self.env.reset(**kwargs)
+        return self._obs(obs), info
+
+    def step(self, action: Any):
+        obs, reward, done, truncated, info = self.env.step(action)
+        return self._obs(obs), reward, done, truncated, info
+
+
+class _ImageTransform(gym.ObservationWrapper):
+    """Resize / grayscale / ensure-NHWC for every cnn key
+    (reference env.py:161-198 transform_obs — minus the CHW transpose)."""
+
+    def __init__(self, env: gym.Env, cnn_keys, screen_size: int, grayscale: bool):
+        super().__init__(env)
+        self._cnn_keys = list(cnn_keys)
+        self._screen = int(screen_size)
+        self._gray = bool(grayscale)
+        spaces = dict(env.observation_space.spaces)
+        for k in self._cnn_keys:
+            spaces[k] = gym.spaces.Box(
+                0, 255, (self._screen, self._screen, 1 if self._gray else 3), np.uint8
+            )
+        self.observation_space = gym.spaces.Dict(spaces)
+
+    def observation(self, obs: Dict[str, Any]) -> Dict[str, Any]:
+        import cv2
+
+        for k in self._cnn_keys:
+            img = np.asarray(obs[k])
+            if img.ndim == 2:
+                img = img[..., None]
+            # accept CHW inputs from suite adapters and flip to HWC
+            if img.shape[0] in (1, 3) and img.shape[-1] not in (1, 3):
+                img = np.transpose(img, (1, 2, 0))
+            if img.shape[:2] != (self._screen, self._screen):
+                img = cv2.resize(img, (self._screen, self._screen), interpolation=cv2.INTER_AREA)
+                if img.ndim == 2:
+                    img = img[..., None]
+            if self._gray and img.shape[-1] == 3:
+                img = cv2.cvtColor(img, cv2.COLOR_RGB2GRAY)[..., None]
+            elif not self._gray and img.shape[-1] == 1:
+                img = np.repeat(img, 3, axis=-1)
+            obs[k] = img.astype(np.uint8)
+        return obs
+
+
+def make_env(
+    cfg: Config,
+    seed: int,
+    rank: int,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+    vector_env_idx: int = 0,
+) -> Callable[[], gym.Env]:
+    def thunk() -> gym.Env:
+        wrapper_cfg = cfg.env.wrapper
+        instantiate_kwargs: Dict[str, Any] = {}
+        if "seed" in wrapper_cfg:
+            instantiate_kwargs["seed"] = seed
+        if "rank" in wrapper_cfg:
+            instantiate_kwargs["rank"] = rank + vector_env_idx
+        env = instantiate(wrapper_cfg, **instantiate_kwargs)
+
+        if cfg.env.get("action_repeat", 1) > 1:
+            env = ActionRepeat(env, cfg.env.action_repeat)
+        if cfg.env.get("mask_velocities", False):
+            env = MaskVelocityWrapper(env)
+
+        cnn_enc = list(cfg.algo.cnn_keys.encoder or [])
+        mlp_enc = list(cfg.algo.mlp_keys.encoder or [])
+        if len(cnn_enc) + len(mlp_enc) == 0:
+            raise ValueError(
+                "`algo.cnn_keys.encoder` and `algo.mlp_keys.encoder` must be lists "
+                "of strings with at least one key between them"
+            )
+
+        # -- lift into Dict observation space (reference env.py:99-141) ----
+        obs_space = env.observation_space
+        if isinstance(obs_space, gym.spaces.Box) and len(obs_space.shape) < 2:
+            if cnn_enc:
+                if len(cnn_enc) > 1:
+                    warnings.warn(f"Only the first cnn key is kept: {cnn_enc[0]}")
+                env = _RenderObs(env, cnn_enc[0], mlp_enc[0] if mlp_enc else None)
+            else:
+                if len(mlp_enc) > 1:
+                    warnings.warn(f"Only the first mlp key is kept: {mlp_enc[0]}")
+                env = _DictObs(env, mlp_enc[0])
+        elif isinstance(obs_space, gym.spaces.Box) and 2 <= len(obs_space.shape) <= 3:
+            if not cnn_enc:
+                raise ValueError(
+                    "Pixel-only environment but no cnn key specified: set `algo.cnn_keys.encoder`"
+                )
+            if len(cnn_enc) > 1:
+                warnings.warn(f"Only the first cnn key is kept: {cnn_enc[0]}")
+            env = _DictObs(env, cnn_enc[0])
+
+        if not isinstance(env.observation_space, gym.spaces.Dict):
+            raise RuntimeError(f"Unsupported observation space {env.observation_space}")
+        requested = set(cnn_enc + mlp_enc)
+        available = set(env.observation_space.spaces.keys())
+        if not requested & available:
+            raise ValueError(
+                f"The user-specified keys {sorted(requested)} are not a subset of the "
+                f"environment observation keys {sorted(available)}"
+            )
+
+        env_cnn_keys = {
+            k for k in env.observation_space.spaces if len(env.observation_space[k].shape) in (2, 3)
+        }
+        cnn_keys = sorted(env_cnn_keys & set(cnn_enc))
+        if cnn_keys:
+            env = _ImageTransform(env, cnn_keys, cfg.env.screen_size, cfg.env.get("grayscale", False))
+            if cfg.env.get("frame_stack", 1) > 1:
+                if cfg.env.get("frame_stack_dilation", 1) <= 0:
+                    raise ValueError(
+                        f"frame_stack_dilation must be > 0, got {cfg.env.frame_stack_dilation}"
+                    )
+                env = FrameStack(env, cfg.env.frame_stack, cnn_keys, cfg.env.frame_stack_dilation)
+
+        actions_as_obs = cfg.env.get("actions_as_observation", None)
+        if actions_as_obs and actions_as_obs.get("num_stack", 0) > 0:
+            env = ActionsAsObservationWrapper(
+                env,
+                num_stack=actions_as_obs.num_stack,
+                noop=actions_as_obs.noop,
+                dilation=actions_as_obs.get("dilation", 1),
+            )
+        if cfg.env.get("reward_as_observation", False):
+            env = RewardAsObservationWrapper(env)
+
+        env.action_space.seed(seed)
+        env.observation_space.seed(seed)
+        if cfg.env.get("max_episode_steps", None) and cfg.env.max_episode_steps > 0:
+            env = gym.wrappers.TimeLimit(env, max_episode_steps=cfg.env.max_episode_steps)
+        env = gym.wrappers.RecordEpisodeStatistics(env)
+        if (
+            cfg.env.get("capture_video", False)
+            and rank == 0
+            and vector_env_idx == 0
+            and run_name is not None
+        ):
+            if cfg.env.get("grayscale", False):
+                env = GrayscaleRenderWrapper(env)
+            video_dir = os.path.join(run_name, prefix + "_videos" if prefix else "videos")
+            try:
+                env = gym.wrappers.RecordVideo(env, video_dir, disable_logger=True)
+            except Exception:
+                warnings.warn("Video capture unavailable; continuing without RecordVideo")
+        return env
+
+    return thunk
+
+
+def episode_stats(info: Dict[str, Any]):
+    """Yield (reward, length) for every env that finished an episode this step
+    (gymnasium ≥1.0 dict-of-arrays `final_info` format)."""
+    fi = info.get("final_info")
+    if not fi or "episode" not in fi:
+        return
+    ep = fi["episode"]
+    mask = np.asarray(ep.get("_r", np.ones_like(np.atleast_1d(ep["r"]), dtype=bool)))
+    rs, ls = np.atleast_1d(ep["r"]), np.atleast_1d(ep["l"])
+    for i in range(len(rs)):
+        if mask[i]:
+            yield float(rs[i]), float(ls[i])
+
+
+def get_dummy_env(id: str) -> gym.Env:
+    from ..envs.dummy import ContinuousDummyEnv, DiscreteDummyEnv, MultiDiscreteDummyEnv
+
+    if "continuous" in id:
+        return ContinuousDummyEnv()
+    if "multidiscrete" in id:
+        return MultiDiscreteDummyEnv()
+    if "discrete" in id:
+        return DiscreteDummyEnv()
+    raise ValueError(f"Unrecognized dummy environment: {id}")
+
+
+def vectorize(cfg: Config, seed: int, rank: int, run_name: Optional[str] = None, prefix: str = ""):
+    """Build the vector env the reference builds inline in every algo main
+    (e.g. ppo.py:137-150)."""
+    thunks = [
+        make_env(cfg, seed + rank * cfg.env.num_envs + i, rank, run_name, prefix, vector_env_idx=i)
+        for i in range(cfg.env.num_envs)
+    ]
+    # SAME_STEP autoreset = the gymnasium-0.29 semantics the reference train
+    # loops assume: reset obs returned at the done step, true final obs in
+    # info["final_obs"].
+    from gymnasium.vector import AutoresetMode
+
+    if cfg.env.get("sync_env", True):
+        return gym.vector.SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    return gym.vector.AsyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)

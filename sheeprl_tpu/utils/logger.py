@@ -1,0 +1,84 @@
+"""Experiment logging: versioned log dirs + TensorBoard writer.
+
+Mirrors the reference's rank-0 logger + versioned `get_log_dir`
+(sheeprl/utils/logger.py:12-97). Only process 0 writes; the resolved log dir
+is deterministic given root_dir/run_name so all hosts agree without a
+broadcast (JAX is single-controller per host; multi-host runs suffix by
+process index).
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from ..config import Config
+
+
+def get_log_dir(cfg: Config, root_dir: str, run_name: str, new_version: bool = True) -> str:
+    base = Path(os.getcwd()) / "logs" / "runs" / root_dir / run_name
+    base.mkdir(parents=True, exist_ok=True)
+    versions = sorted(
+        int(p.name.split("_")[1])
+        for p in base.iterdir()
+        if p.is_dir() and p.name.startswith("version_") and p.name.split("_")[1].isdigit()
+    )
+    if versions and not new_version:
+        version = versions[-1]
+    else:
+        version = (versions[-1] + 1) if versions else 0
+    log_dir = base / f"version_{version}"
+    log_dir.mkdir(parents=True, exist_ok=True)
+    return str(log_dir)
+
+
+class TensorBoardLogger:
+    """Thin SummaryWriter wrapper; inert on non-zero processes or log_level=0."""
+
+    def __init__(self, log_dir: str, enabled: bool = True):
+        self.log_dir = log_dir
+        self._writer = None
+        self.enabled = enabled
+        if enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._writer = SummaryWriter(log_dir=log_dir)
+            except Exception:
+                try:
+                    from tensorboardX import SummaryWriter  # type: ignore
+
+                    self._writer = SummaryWriter(log_dir=log_dir)
+                except Exception:
+                    self._writer = None
+
+    def log_metrics(self, metrics: Dict[str, Any], step: int) -> None:
+        if self._writer is None:
+            return
+        for name, value in metrics.items():
+            try:
+                self._writer.add_scalar(name, float(value), global_step=step)
+            except (TypeError, ValueError):
+                continue
+
+    def log_hyperparams(self, cfg: Dict[str, Any]) -> None:
+        if self._writer is None:
+            return
+        import yaml
+
+        try:
+            self._writer.add_text("config", "```yaml\n" + yaml.safe_dump(cfg) + "\n```")
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.flush()
+            self._writer.close()
+
+
+def get_logger(cfg: Config, log_dir: str, process_index: int = 0) -> Optional[TensorBoardLogger]:
+    """Rank-0-only logger, honoring metric.log_level (reference logger.py:12-37)."""
+    if process_index != 0 or cfg.select("metric.log_level", 1) == 0:
+        return None
+    return TensorBoardLogger(log_dir)

@@ -1,0 +1,103 @@
+"""Metric aggregation (host-side, numpy — no torchmetrics).
+
+Mirrors the reference's `MetricAggregator` (sheeprl/utils/metric.py:17-143):
+a name → metric dict with `update/compute/reset`, class-level `disabled`,
+NaN filtering on compute. Metrics here are simple running reducers (mean/sum/
+max/last) rather than torchmetrics objects — the TPU build keeps all metric
+state on host so it never interferes with jit.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Mapping, Optional
+
+import numpy as np
+
+
+class RunningMetric:
+    """A running reducer. kind ∈ {mean, sum, max, min, last}."""
+
+    def __init__(self, kind: str = "mean", sync_on_compute: bool = False):
+        self.kind = kind
+        self.sync_on_compute = sync_on_compute
+        self.reset()
+
+    def reset(self) -> None:
+        self._total = 0.0
+        self._count = 0
+        self._value: Optional[float] = None
+
+    def update(self, value: Any) -> None:
+        value = np.asarray(value, dtype=np.float64)
+        if value.size == 0:
+            return
+        v = float(np.mean(value)) if self.kind == "mean" else float(np.sum(value))
+        if self.kind == "mean":
+            self._total += float(np.sum(value))
+            self._count += int(value.size)
+        elif self.kind == "sum":
+            self._total += v
+            self._count += 1
+        elif self.kind == "max":
+            m = float(np.max(value))
+            self._value = m if self._value is None else max(self._value, m)
+        elif self.kind == "min":
+            m = float(np.min(value))
+            self._value = m if self._value is None else min(self._value, m)
+        else:  # last
+            self._value = float(np.mean(value))
+
+    def compute(self) -> Optional[float]:
+        if self.kind == "mean":
+            return self._total / self._count if self._count else None
+        if self.kind == "sum":
+            return self._total if self._count else None
+        return self._value
+
+
+class MetricAggregator:
+    """name → RunningMetric registry with whitelist-style construction.
+
+    Built from a metric config mapping name → {"kind": ...} (the analogue of
+    the reference's `_target_: torchmetrics.MeanMetric` aggregator config,
+    configs/metric/default.yaml) filtered by each algorithm's AGGREGATOR_KEYS
+    (reference cli.py:151-165).
+    """
+
+    disabled: bool = False
+
+    def __init__(self, metrics: Optional[Mapping[str, Any]] = None):
+        self.metrics: Dict[str, RunningMetric] = {}
+        if metrics:
+            for name, spec in metrics.items():
+                kind = spec.get("kind", "mean") if isinstance(spec, Mapping) else str(spec)
+                self.metrics[name] = RunningMetric(kind)
+
+    def add(self, name: str, kind: str = "mean") -> None:
+        if name not in self.metrics:
+            self.metrics[name] = RunningMetric(kind)
+
+    def update(self, name: str, value: Any) -> None:
+        if MetricAggregator.disabled:
+            return
+        if name not in self.metrics:
+            return
+        self.metrics[name].update(value)
+
+    def compute(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        if MetricAggregator.disabled:
+            return out
+        for name, metric in self.metrics.items():
+            v = metric.compute()
+            if v is None or math.isnan(v) or math.isinf(v):
+                continue
+            out[name] = v
+        return out
+
+    def reset(self) -> None:
+        for metric in self.metrics.values():
+            metric.reset()
+
+    def to(self, *_a, **_k) -> "MetricAggregator":  # host-only
+        return self

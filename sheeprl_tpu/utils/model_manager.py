@@ -1,0 +1,112 @@
+"""Local file-based model registry.
+
+The reference's model manager is MLflow-backed (sheeprl/utils/mlflow.py:75-427:
+register/transition/delete/download model versions). MLflow isn't part of the
+TPU image, so the same lifecycle is implemented over a directory registry
+(`models_registry/<name>/v<N>/`): each version stores the serialized params
+tree + metadata. The public surface (`register_model`,
+`register_models_from_checkpoint`) matches the call sites at the end of every
+training loop (reference ppo.py:447-452, cli.py:408-450).
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class ModelManager:
+    def __init__(self, registry_dir: str = "models_registry", disabled: bool = False):
+        self.root = pathlib.Path(registry_dir)
+        self.disabled = disabled
+
+    def register_model(self, name: str, params: Any, description: str = "", tags: Optional[Dict] = None) -> Optional[str]:
+        if self.disabled:
+            return None
+        model_dir = self.root / name
+        model_dir.mkdir(parents=True, exist_ok=True)
+        versions = sorted(
+            int(p.name[1:]) for p in model_dir.iterdir() if p.is_dir() and p.name.startswith("v")
+        )
+        version = (versions[-1] + 1) if versions else 1
+        vdir = model_dir / f"v{version}"
+        vdir.mkdir()
+        host_params = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), params)
+        with open(vdir / "params.pkl", "wb") as f:
+            pickle.dump(host_params, f)
+        meta = {
+            "name": name,
+            "version": version,
+            "description": description,
+            "tags": tags or {},
+            "created_at": time.time(),
+            "stage": "None",
+        }
+        with open(vdir / "meta.json", "w") as f:
+            json.dump(meta, f, indent=2)
+        return str(vdir)
+
+    def get_latest_version(self, name: str) -> Optional[int]:
+        model_dir = self.root / name
+        if not model_dir.is_dir():
+            return None
+        versions = sorted(
+            int(p.name[1:]) for p in model_dir.iterdir() if p.is_dir() and p.name.startswith("v")
+        )
+        return versions[-1] if versions else None
+
+    def download_model(self, name: str, version: Optional[int] = None) -> Any:
+        version = version or self.get_latest_version(name)
+        if version is None:
+            raise FileNotFoundError(f"No registered model '{name}'")
+        with open(self.root / name / f"v{version}" / "params.pkl", "rb") as f:
+            return pickle.load(f)
+
+    def transition_model(self, name: str, version: int, stage: str) -> None:
+        meta_path = self.root / name / f"v{version}" / "meta.json"
+        meta = json.loads(meta_path.read_text())
+        meta["stage"] = stage
+        meta_path.write_text(json.dumps(meta, indent=2))
+
+    def delete_model(self, name: str, version: Optional[int] = None) -> None:
+        import shutil
+
+        target = self.root / name if version is None else self.root / name / f"v{version}"
+        if target.exists():
+            shutil.rmtree(target)
+
+
+def register_model(cfg: Any, models: Dict[str, Any], log_dir: str) -> None:
+    """End-of-training hook (reference ppo.py:447-452): register each of the
+    algorithm's MODELS_TO_REGISTER if model_manager is enabled."""
+    mm_cfg = cfg.select("model_manager") or {}
+    if mm_cfg.get("disabled", True):
+        return
+    manager = ModelManager()
+    for name, params in models.items():
+        spec = (mm_cfg.get("models") or {}).get(name, {})
+        manager.register_model(
+            f"{cfg.algo.name}_{cfg.env.id}_{name}",
+            params,
+            description=spec.get("description", ""),
+            tags=spec.get("tags", {}),
+        )
+
+
+def register_models_from_checkpoint(ckpt_path: pathlib.Path, overrides: Sequence[str]) -> None:
+    """`sheeprl_tpu registration` backend (reference cli.py:408-450)."""
+    from .checkpoint import CheckpointManager
+    from ..config import load_config_file
+
+    cfg_path = ckpt_path.parent.parent / "config.yaml"
+    cfg = load_config_file(cfg_path)
+    state = CheckpointManager.load(ckpt_path)
+    manager = ModelManager()
+    for key, value in state.items():
+        if key.endswith("params") and value is not None:
+            manager.register_model(f"{cfg.select('algo.name')}_{cfg.select('env.id')}_{key}", value)

@@ -1,0 +1,70 @@
+"""Algorithm / evaluation registries.
+
+Mirrors the reference's decorator registry (sheeprl/utils/registry.py:15-108):
+``@register_algorithm(decoupled=...)`` records name → (module, entrypoint,
+decoupled); ``@register_evaluation(algorithms=...)`` records the eval function
+for one or more algorithm names. The CLI resolves ``cfg.algo.name`` through
+these tables (reference cli.py:82-98, 237-243).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+algorithm_registry: Dict[str, Dict[str, Any]] = {}
+evaluation_registry: Dict[str, Dict[str, Any]] = {}
+
+
+def register_algorithm(name: Optional[str] = None, decoupled: bool = False) -> Callable:
+    """Register a training entrypoint ``main(cfg) -> None`` under ``name``.
+
+    If ``name`` is omitted the function's module's last package name is used
+    (e.g. ``sheeprl_tpu.algos.ppo.ppo`` registers as ``ppo``).
+    """
+
+    def wrap(fn: Callable) -> Callable:
+        key = name or fn.__module__.rsplit(".", 2)[-1]
+        if key in algorithm_registry:
+            raise ValueError(f"Algorithm '{key}' already registered")
+        algorithm_registry[key] = {
+            "name": key,
+            "module": fn.__module__,
+            "entrypoint": fn.__name__,
+            "fn": fn,
+            "decoupled": decoupled,
+        }
+        return fn
+
+    return wrap
+
+
+def register_evaluation(algorithms: Union[str, Sequence[str]]) -> Callable:
+    def wrap(fn: Callable) -> Callable:
+        names: List[str] = [algorithms] if isinstance(algorithms, str) else list(algorithms)
+        for key in names:
+            if key in evaluation_registry:
+                raise ValueError(f"Evaluation for '{key}' already registered")
+            evaluation_registry[key] = {
+                "name": key,
+                "module": fn.__module__,
+                "entrypoint": fn.__name__,
+                "fn": fn,
+            }
+        return fn
+
+    return wrap
+
+
+def get_algorithm(name: str) -> Dict[str, Any]:
+    if name not in algorithm_registry:
+        raise ValueError(
+            f"Algorithm '{name}' is not registered. Available: {sorted(algorithm_registry)}"
+        )
+    return algorithm_registry[name]
+
+
+def get_evaluation(name: str) -> Dict[str, Any]:
+    if name not in evaluation_registry:
+        raise ValueError(
+            f"No evaluation registered for '{name}'. Available: {sorted(evaluation_registry)}"
+        )
+    return evaluation_registry[name]

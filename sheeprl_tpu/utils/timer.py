@@ -1,0 +1,46 @@
+"""Wall-clock timers accumulated into a process-wide registry.
+
+Equivalent of the reference's `timer` ContextDecorator over torchmetrics
+SumMetric (sheeprl/utils/timer.py:16-85): ``with timer("Time/train_time"):``
+accumulates seconds; `timer.compute()` drains all timers. Class-level
+``disabled`` mirrors `metric.disable_timer`.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import ContextDecorator
+from typing import Dict, Optional
+
+
+class timer(ContextDecorator):
+    disabled: bool = False
+    _timers: Dict[str, float] = {}
+
+    def __init__(self, name: str):
+        self.name = name
+        self._start: Optional[float] = None
+
+    def __enter__(self) -> "timer":
+        if not timer.disabled:
+            self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if not timer.disabled and self._start is not None:
+            timer._timers[self.name] = timer._timers.get(self.name, 0.0) + (
+                time.perf_counter() - self._start
+            )
+        self._start = None
+        return False
+
+    @classmethod
+    def to(cls, *_args, **_kwargs) -> None:  # device no-op (host-only timers)
+        return None
+
+    @classmethod
+    def compute(cls) -> Dict[str, float]:
+        return dict(cls._timers)
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._timers.clear()

@@ -1,0 +1,82 @@
+"""Shared helpers: replay-ratio controller, schedules, config printing.
+
+`Ratio` reproduces the reference's gradient-steps/policy-steps controller
+(sheeprl/utils/utils.py:259-300). Numeric transforms (symlog, two-hot, GAE)
+live in `sheeprl_tpu.ops` because on TPU they are jitted device code.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class Ratio:
+    """Replay-ratio controller: how many gradient steps to run for the env
+    steps taken since the last update (reference utils.py:259-300)."""
+
+    def __init__(self, ratio: float, pretrain_steps: int = 0):
+        if pretrain_steps < 0:
+            raise ValueError(f"'pretrain_steps' must be non-negative, got {pretrain_steps}")
+        if ratio < 0:
+            raise ValueError(f"'ratio' must be non-negative, got {ratio}")
+        self._pretrain_steps = pretrain_steps
+        self._ratio = ratio
+        self._prev: Optional[float] = None
+
+    def __call__(self, step: float) -> int:
+        if self._ratio == 0:
+            return 0
+        if self._prev is None:
+            self._prev = step
+            repeats = int(self._pretrain_steps * self._ratio)
+            if self._pretrain_steps > 0 and repeats == 0:
+                repeats = 1
+            return repeats
+        repeats = round((step - self._prev) * self._ratio)
+        self._prev += repeats / self._ratio
+        return int(repeats)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"_ratio": self._ratio, "_prev": self._prev, "_pretrain_steps": self._pretrain_steps}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> "Ratio":
+        self._ratio = float(state["_ratio"])
+        self._prev = state["_prev"]
+        self._pretrain_steps = int(state["_pretrain_steps"])
+        return self
+
+
+def linear_annealing(initial: float, step: int, total_steps: int, final: float = 0.0) -> float:
+    """LR / clip-coef annealing (reference ppo.py:414-424 uses torch scheds)."""
+    frac = min(max(step / max(total_steps, 1), 0.0), 1.0)
+    return initial + frac * (final - initial)
+
+
+def print_config(cfg: Any) -> None:
+    """Rich tree dump of the composed config (reference utils.py:208-237)."""
+    import yaml
+
+    try:
+        from rich.console import Console
+        from rich.syntax import Syntax
+
+        Console().print(Syntax(yaml.safe_dump(cfg.to_dict(), sort_keys=False), "yaml"))
+    except Exception:
+        print(yaml.safe_dump(cfg.to_dict(), sort_keys=False))
+
+
+def save_configs(cfg: Any, log_dir: str) -> None:
+    from ..config import save_config
+
+    save_config(cfg, f"{log_dir}/config.yaml")
+
+
+def unwrap_fabric(obj: Any) -> Any:  # parity shim; no wrapping exists here
+    return obj
+
+
+def dotdict(d: Any) -> Any:
+    from ..config import Config
+
+    return Config(d) if not isinstance(d, Config) else d

@@ -1,0 +1,47 @@
+"""Test fixtures (counterpart of reference tests/conftest.py).
+
+Forces CPU-JAX with 8 virtual devices — the analogue of the reference's
+LT_DEVICES=2 gloo-spawn trick (conftest.py:16-18): multi-device sharding is
+exercised without TPU hardware.
+
+NOTE: on axon-tunneled machines a sitecustomize registers the TPU backend at
+interpreter start and forces `jax_platforms`; env vars alone don't stick, so
+we set the config knob after importing jax.
+"""
+import os
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def chdir_tmp(tmp_path, monkeypatch):
+    """Each test runs in a fresh cwd so logs/ and memmaps don't leak."""
+    monkeypatch.chdir(tmp_path)
+    yield
+
+
+@pytest.fixture(params=["1", "2"])
+def devices(request):
+    """Parametrize over 1 and 2 mesh devices (reference conftest devices)."""
+    return request.param
+
+
+@pytest.fixture()
+def standard_args():
+    return [
+        "dry_run=True",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "metric.log_level=0",
+        "checkpoint.save_last=False",
+    ]

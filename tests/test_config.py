@@ -1,0 +1,50 @@
+import pytest
+
+from sheeprl_tpu.config import Config, compose, instantiate
+
+
+def test_container_attribute_access():
+    cfg = Config({"a": {"b": 1}, "c": [1, {"d": 2}]})
+    assert cfg.a.b == 1
+    assert cfg.c[1].d == 2
+    cfg.set_path("a.x.y", 5)
+    assert cfg.a.x.y == 5
+    assert cfg.select("a.b") == 1
+    assert cfg.select("missing.path", 42) == 42
+
+
+def test_merge_deep():
+    cfg = Config({"a": {"b": 1, "c": 2}})
+    cfg.merge({"a": {"b": 10}, "d": 3})
+    assert cfg.a.b == 10 and cfg.a.c == 2 and cfg.d == 3
+
+
+def test_compose_ppo_exp():
+    cfg = compose("config", ["exp=ppo"])
+    assert cfg.algo.name == "ppo"
+    assert cfg.algo.total_steps == 65536
+    assert cfg.algo.optimizer.lr == 1e-3
+    # interpolation
+    assert cfg.algo.encoder.dense_units == cfg.algo.dense_units
+    assert cfg.exp_name == "ppo_CartPole-v1"
+    assert cfg.buffer.size == cfg.algo.rollout_steps
+
+
+def test_compose_overrides():
+    cfg = compose("config", ["exp=ppo", "algo.rollout_steps=32", "env.num_envs=2", "seed=7"])
+    assert cfg.algo.rollout_steps == 32
+    assert cfg.buffer.size == 32  # interpolation follows the override
+    assert cfg.env.num_envs == 2
+    assert cfg.seed == 7
+
+
+def test_compose_missing_exp_raises():
+    with pytest.raises(ValueError):
+        compose("config", [])
+
+
+def test_instantiate_target():
+    obj = instantiate({"_target_": "collections.OrderedDict", "a": 1})
+    assert obj["a"] == 1
+    fn = instantiate({"_target_": "operator.add", "_partial_": True})
+    assert fn(2, 3) == 5
