@@ -1,0 +1,1 @@
+from . import a2c  # noqa: F401 — registers the algorithm + evaluation

@@ -1,0 +1,35 @@
+"""A2C actor-critic (reference sheeprl/algos/a2c/agent.py, 203 LoC).
+
+Vector observations only: an MLP feature encoder per key + actor/critic
+trunks. Reuses the PPO head/sampling machinery — the architectures are
+structurally identical, A2C simply has no CNN path.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import gymnasium as gym
+import jax
+import numpy as np
+
+from ..ppo.agent import PPOAgent, actions_and_log_probs, build_agent as _ppo_build_agent
+
+__all__ = ["A2CAgent", "actions_and_log_probs", "build_agent"]
+
+A2CAgent = PPOAgent
+
+
+def build_agent(
+    dist: Any,
+    cfg: Any,
+    observation_space: gym.spaces.Dict,
+    action_space: gym.Space,
+    key: jax.Array,
+    params: Optional[Any] = None,
+) -> Tuple[PPOAgent, Any]:
+    if cfg.algo.cnn_keys.encoder:
+        raise ValueError(
+            "A2C only supports vector observations (reference a2c/agent.py) — "
+            f"got cnn keys {cfg.algo.cnn_keys.encoder}"
+        )
+    return _ppo_build_agent(dist, cfg, observation_space, action_space, key, params)

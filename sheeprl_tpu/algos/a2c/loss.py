@@ -1,0 +1,16 @@
+"""A2C losses (reference sheeprl/algos/a2c/loss.py): vanilla policy gradient
+with advantages + value MSE."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def policy_loss(logprobs: jax.Array, advantages: jax.Array, reduction: str = "sum") -> jax.Array:
+    loss = -logprobs * advantages
+    return jnp.mean(loss) if reduction == "mean" else jnp.sum(loss)
+
+
+def value_loss(values: jax.Array, returns: jax.Array, reduction: str = "sum") -> jax.Array:
+    loss = 0.5 * jnp.square(values - returns)
+    return jnp.mean(loss) if reduction == "mean" else jnp.sum(loss)

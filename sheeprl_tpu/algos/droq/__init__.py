@@ -1,0 +1,1 @@
+from . import droq  # noqa: F401 — registers the algorithm + evaluation

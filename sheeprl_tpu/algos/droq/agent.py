@@ -1,0 +1,93 @@
+"""DroQ agent (reference sheeprl/algos/droq/agent.py, 278 LoC).
+
+DroQ = SAC with Dropout+LayerNorm Q-networks (https://arxiv.org/abs/2110.02034)
+trained at a high replay ratio. The critic ensemble is `nn.vmap`-lifted like
+SAC's; dropout rngs are split per ensemble member so each critic sees
+independent masks (the source of DroQ's implicit ensembling).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models import MLP
+from ..sac.agent import SACActor
+
+__all__ = ["DROQCritic", "make_droq_critic_ensemble", "build_agent"]
+
+
+class DROQCritic(nn.Module):
+    """Q(s,a): Linear → Dropout → LayerNorm → ReLU ×2 → head
+    (reference droq/agent.py:20-54)."""
+
+    hidden_size: int = 256
+    num_critics: int = 1
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array, deterministic: bool = True) -> jax.Array:
+        x = jnp.concatenate([obs, action], axis=-1)
+        return MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            output_dim=self.num_critics,
+            activation="relu",
+            dropout=self.dropout,
+            norm_layer="layernorm",
+        )(x, deterministic=deterministic)
+
+
+def make_droq_critic_ensemble(hidden_size: int, n: int, dropout: float) -> nn.Module:
+    return nn.vmap(
+        DROQCritic,
+        in_axes=None,
+        out_axes=0,
+        axis_size=n,
+        variable_axes={"params": 0},
+        split_rngs={"params": True, "dropout": True},
+    )(hidden_size=hidden_size, dropout=dropout)
+
+
+def build_agent(
+    dist: Any,
+    cfg: Any,
+    observation_space: gym.spaces.Dict,
+    action_space: gym.spaces.Box,
+    key: jax.Array,
+    state: Optional[Dict[str, Any]] = None,
+) -> Tuple[SACActor, nn.Module, Dict[str, Any]]:
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError(f"DroQ supports continuous (Box) actions only, got {action_space}")
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_dim = int(sum(np.prod(observation_space[k].shape) for k in mlp_keys))
+    act_dim = int(np.prod(action_space.shape))
+    actor = SACActor(
+        action_dim=act_dim,
+        hidden_size=cfg.algo.actor.hidden_size,
+        action_low=action_space.low.tolist(),
+        action_high=action_space.high.tolist(),
+    )
+    critic = make_droq_critic_ensemble(
+        cfg.algo.critic.hidden_size, int(cfg.algo.critic.n), float(cfg.algo.critic.dropout)
+    )
+    if state is not None:
+        params = state
+    else:
+        ka, kc = jax.random.split(key)
+        dummy_obs = jnp.zeros((1, obs_dim))
+        dummy_act = jnp.zeros((1, act_dim))
+        actor_params = actor.init(ka, dummy_obs)["params"]
+        critic_params = critic.init(kc, dummy_obs, dummy_act)["params"]
+        params = {
+            "actor": actor_params,
+            "critic": critic_params,
+            # real copy — aliasing the critic buffers breaks donation
+            "target_critic": jax.tree.map(jnp.copy, critic_params),
+            "log_alpha": jnp.asarray(jnp.log(cfg.algo.alpha.alpha), jnp.float32),
+        }
+    params = dist.replicate(params)
+    return actor, critic, params

@@ -1,0 +1,139 @@
+"""SAC agent (reference sheeprl/algos/sac/agent.py, 371 LoC).
+
+TPU-native re-design:
+* `SACActor` — 2-layer MLP → mean/log_std heads, tanh-squashed Gaussian with
+  the Eq.-26 log-prob correction (reference agent.py:92-143), action rescaling
+  to env bounds.
+* Critic ensemble — the reference builds N independent `SACCritic` networks
+  (:20-54, :145-267 with EMA targets); here the ensemble is ONE module
+  `nn.vmap`-lifted over a leading parameter axis, so all N Q-networks run as
+  a single batched matmul on the MXU.
+* No `SACPlayer` duality (:270-340): rollout reuses the same apply fn.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models import MLP
+
+LOG_STD_MAX = 2.0
+LOG_STD_MIN = -5.0
+
+
+class SACActor(nn.Module):
+    action_dim: int
+    hidden_size: int = 256
+    action_low: Any = -1.0
+    action_high: Any = 1.0
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = MLP(hidden_sizes=(self.hidden_size, self.hidden_size), activation="relu")(obs)
+        mean = nn.Dense(self.action_dim, name="fc_mean")(x)
+        log_std = nn.Dense(self.action_dim, name="fc_logstd")(x)
+        return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    @property
+    def action_scale(self) -> jax.Array:
+        return jnp.asarray((np.asarray(self.action_high) - np.asarray(self.action_low)) / 2.0, jnp.float32)
+
+    @property
+    def action_bias(self) -> jax.Array:
+        return jnp.asarray((np.asarray(self.action_high) + np.asarray(self.action_low)) / 2.0, jnp.float32)
+
+
+def sample_actions(
+    actor: SACActor,
+    mean: jax.Array,
+    log_std: jax.Array,
+    key: Optional[jax.Array],
+    greedy: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Tanh-squashed rsample + Eq.-26 log-prob (reference agent.py:110-143)."""
+    std = jnp.exp(log_std)
+    if greedy or key is None:
+        x_t = mean
+    else:
+        x_t = mean + std * jax.random.normal(key, mean.shape)
+    y_t = jnp.tanh(x_t)
+    action = y_t * actor.action_scale + actor.action_bias
+    var = jnp.square(std)
+    log_prob = -0.5 * (jnp.square(x_t - mean) / var + jnp.log(2 * jnp.pi * var))
+    log_prob = log_prob - jnp.log(actor.action_scale * (1 - jnp.square(y_t)) + 1e-6)
+    return action, jnp.sum(log_prob, axis=-1, keepdims=True)
+
+
+class SACCritic(nn.Module):
+    """Q(s, a) — 2-layer ReLU MLP on concat(obs, action) (reference :20-54)."""
+
+    hidden_size: int = 256
+    num_critics: int = 1
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        x = jnp.concatenate([obs, action], axis=-1)
+        return MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            output_dim=self.num_critics,
+            activation="relu",
+        )(x)
+
+
+def make_critic_ensemble(hidden_size: int, n: int) -> nn.Module:
+    """N independent critics as one vmapped module (leading param axis)."""
+    return nn.vmap(
+        SACCritic,
+        in_axes=None,
+        out_axes=0,
+        axis_size=n,
+        variable_axes={"params": 0},
+        split_rngs={"params": True},
+    )(hidden_size=hidden_size)
+
+
+def build_agent(
+    dist: Any,
+    cfg: Any,
+    observation_space: gym.spaces.Dict,
+    action_space: gym.spaces.Box,
+    key: jax.Array,
+    state: Optional[Dict[str, Any]] = None,
+) -> Tuple[SACActor, nn.Module, Dict[str, Any]]:
+    """Returns (actor_module, critic_module, params) with params =
+    {actor, critic, target_critic, log_alpha} (reference agent.py:145-267:
+    SACAgent holds critics + EMA targets + learnable log_alpha)."""
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError(f"SAC supports continuous (Box) actions only, got {action_space}")
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_dim = int(sum(np.prod(observation_space[k].shape) for k in mlp_keys))
+    act_dim = int(np.prod(action_space.shape))
+    actor = SACActor(
+        action_dim=act_dim,
+        hidden_size=cfg.algo.actor.hidden_size,
+        action_low=action_space.low.tolist(),
+        action_high=action_space.high.tolist(),
+    )
+    critic = make_critic_ensemble(cfg.algo.critic.hidden_size, int(cfg.algo.critic.n))
+    if state is not None:
+        params = state
+    else:
+        ka, kc = jax.random.split(key)
+        dummy_obs = jnp.zeros((1, obs_dim))
+        dummy_act = jnp.zeros((1, act_dim))
+        actor_params = actor.init(ka, dummy_obs)["params"]
+        critic_params = critic.init(kc, dummy_obs, dummy_act)["params"]
+        params = {
+            "actor": actor_params,
+            "critic": critic_params,
+            # real copy — aliasing the critic buffers breaks donation
+            "target_critic": jax.tree.map(jnp.copy, critic_params),
+            "log_alpha": jnp.asarray(jnp.log(cfg.algo.alpha.alpha), jnp.float32),
+        }
+    params = dist.replicate(params)
+    return actor, critic, params

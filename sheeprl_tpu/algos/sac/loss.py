@@ -1,0 +1,19 @@
+"""SAC losses (reference sheeprl/algos/sac/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def critic_loss(qf_values: jax.Array, next_qf_value: jax.Array, num_critics: int) -> jax.Array:
+    """Sum of per-critic MSEs against the shared target.
+    qf_values: [n, B, 1]; next_qf_value: [B, 1]."""
+    return jnp.sum(jnp.mean(jnp.square(qf_values - next_qf_value[None]), axis=(1, 2)))
+
+
+def policy_loss(alpha: jax.Array, logprobs: jax.Array, min_qf_values: jax.Array) -> jax.Array:
+    return jnp.mean(alpha * logprobs - min_qf_values)
+
+
+def entropy_loss(log_alpha: jax.Array, logprobs: jax.Array, target_entropy: float) -> jax.Array:
+    return jnp.mean(-log_alpha * (logprobs + target_entropy))

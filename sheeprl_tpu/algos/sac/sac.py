@@ -1,0 +1,316 @@
+"""SAC — coupled off-policy training (Template B).
+
+Reference sheeprl/algos/sac/sac.py (427 LoC). TPU-native re-design:
+
+* `Ratio`-controlled gradient steps: the reference samples ONE big batch per
+  iteration and slices it per gradient step (sac.py:300-337); here the
+  [G, B, ...] batch crosses host→HBM once and the G gradient steps run as a
+  single jitted `lax.scan` with donated carry (params of 3 optimizers +
+  target EMA folded in — reference train() sac.py:32-75).
+* alpha auto-tune: log_alpha is just another leaf in the params pytree; the
+  grad all_reduce the reference does by hand (sac.py:72) falls out of the
+  sharded jit.
+* Target-critic EMA (`tau` polyak) happens inside the scan every
+  `target_network_frequency` steps.
+"""
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ...config import Config, instantiate
+from ...data import ReplayBuffer
+from ...parallel import Distributed
+from ...utils.checkpoint import CheckpointManager
+from ...utils.env import episode_stats, vectorize
+from ...utils.logger import get_log_dir, get_logger
+from ...utils.metric import MetricAggregator
+from ...utils.registry import register_algorithm, register_evaluation
+from ...utils.timer import timer
+from ...utils.utils import Ratio, save_configs
+from .agent import SACActor, build_agent, sample_actions
+from .loss import critic_loss, entropy_loss, policy_loss
+from .utils import AGGREGATOR_KEYS, flatten_obs, prepare_obs, test
+
+
+def make_train_fn(actor, critic, txs, cfg: Config, target_entropy: float):
+    gamma = float(cfg.algo.gamma)
+    tau = float(cfg.algo.tau)
+    tnf = int(cfg.algo.critic.target_network_frequency)
+
+    def one_step(carry, inp):
+        params, opt_states = carry
+        batch, key = inp
+
+        # --- critic update ------------------------------------------------
+        mean, log_std = actor.apply({"params": params["actor"]}, batch["next_observations"])
+        key, k1 = jax.random.split(key)
+        next_actions, next_logprobs = sample_actions(actor, mean, log_std, k1)
+        target_q = critic.apply(
+            {"params": params["target_critic"]}, batch["next_observations"], next_actions
+        )  # [n, B, 1]
+        min_target = jnp.min(target_q, axis=0) - jnp.exp(params["log_alpha"]) * next_logprobs
+        # bootstrap through truncation: only true termination stops the return
+        # (reference sac.py target uses data["terminated"], not dones)
+        y = batch["rewards"] + (1.0 - batch["terminated"]) * gamma * min_target
+
+        def qf_loss_fn(critic_params):
+            q = critic.apply({"params": critic_params}, batch["observations"], batch["actions"])
+            return critic_loss(q, jax.lax.stop_gradient(y), q.shape[0])
+
+        qf_loss, qf_grads = jax.value_and_grad(qf_loss_fn)(params["critic"])
+        updates, opt_states["critic"] = txs["critic"].update(
+            qf_grads, opt_states["critic"], params["critic"]
+        )
+        params["critic"] = optax.apply_updates(params["critic"], updates)
+
+        # --- actor update -------------------------------------------------
+        def actor_loss_fn(actor_params):
+            m, ls = actor.apply({"params": actor_params}, batch["observations"])
+            key_a = jax.random.fold_in(key, 1)
+            acts, logp = sample_actions(actor, m, ls, key_a)
+            q = critic.apply({"params": params["critic"]}, batch["observations"], acts)
+            min_q = jnp.min(q, axis=0)
+            return policy_loss(jnp.exp(params["log_alpha"]), logp, min_q), logp
+
+        (a_loss, logp), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(params["actor"])
+        updates, opt_states["actor"] = txs["actor"].update(a_grads, opt_states["actor"], params["actor"])
+        params["actor"] = optax.apply_updates(params["actor"], updates)
+
+        # --- alpha update -------------------------------------------------
+        def alpha_loss_fn(log_alpha):
+            return entropy_loss(log_alpha, jax.lax.stop_gradient(logp), target_entropy)
+
+        al_loss, al_grad = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"])
+        updates, opt_states["alpha"] = txs["alpha"].update(al_grad, opt_states["alpha"], params["log_alpha"])
+        params["log_alpha"] = optax.apply_updates(params["log_alpha"], updates)
+
+        # --- target EMA (reference sac.py:74-75 / agent.py qf_target update)
+        step = opt_states["step"] + 1
+        do_update = (step % tnf) == 0
+        params["target_critic"] = jax.tree.map(
+            lambda t, s: jnp.where(do_update, (1 - tau) * t + tau * s, t),
+            params["target_critic"],
+            params["critic"],
+        )
+        opt_states["step"] = step
+
+        metrics = {
+            "Loss/value_loss": qf_loss,
+            "Loss/policy_loss": a_loss,
+            "Loss/alpha_loss": al_loss,
+        }
+        return (params, opt_states), metrics
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train(params, opt_states, batches, keys):
+        (params, opt_states), metrics = jax.lax.scan(one_step, (params, opt_states), (batches, keys))
+        return params, opt_states, jax.tree.map(jnp.mean, metrics)
+
+    return train
+
+
+@register_algorithm(name="sac")
+def main(dist: Distributed, cfg: Config) -> None:
+    root_key = dist.seed_everything(cfg.seed)
+    rank = dist.process_index
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
+    logger = get_logger(cfg, log_dir, rank)
+    if rank == 0:
+        save_configs(cfg, log_dir)
+
+    envs = vectorize(cfg, cfg.seed, rank, log_dir)
+    obs_space = envs.single_observation_space
+    action_space = envs.single_action_space
+    num_envs = int(cfg.env.num_envs)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    if not isinstance(action_space, gym.spaces.Box):
+        raise RuntimeError("SAC requires a continuous (Box) action space")
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        state = CheckpointManager.load(cfg.checkpoint.resume_from)
+    root_key, init_key = jax.random.split(state["rng"] if state else root_key)
+    actor, critic, params = build_agent(
+        dist, cfg, obs_space, action_space, init_key, state["params"] if state else None
+    )
+    act_dim = int(np.prod(action_space.shape))
+    target_entropy = -act_dim
+
+    txs = {
+        "actor": instantiate(cfg.algo.actor.optimizer),
+        "critic": instantiate(cfg.algo.critic.optimizer),
+        "alpha": instantiate(cfg.algo.alpha.optimizer),
+    }
+    if state:
+        opt_states = state["opt_states"]
+    else:
+        opt_states = {
+            "actor": txs["actor"].init(params["actor"]),
+            "critic": txs["critic"].init(params["critic"]),
+            "alpha": txs["alpha"].init(params["log_alpha"]),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    buffer_size = int(cfg.buffer.size) if not cfg.dry_run else max(2 * num_envs, 8)
+    rb = ReplayBuffer(
+        buffer_size,
+        num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+    )
+    if state and cfg.buffer.checkpoint and "rb" in state:
+        rb.load_state_dict(state["rb"])
+
+    train = make_train_fn(actor, critic, txs, cfg, target_entropy)
+
+    @jax.jit
+    def act(actor_params, obs, key):
+        mean, log_std = actor.apply({"params": actor_params}, obs)
+        actions, _ = sample_actions(actor, mean, log_std, key)
+        return actions
+
+    aggregator = MetricAggregator(
+        {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
+    )
+    ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state and "ratio" in state:
+        ratio.load_state_dict(state["ratio"])
+
+    batch_size = int(cfg.algo.per_rank_batch_size) * dist.world_size
+    total_steps = int(cfg.algo.total_steps) if not cfg.dry_run else num_envs
+    learning_starts = int(cfg.algo.learning_starts) if not cfg.dry_run else 0
+    policy_step = state["policy_step"] if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    cumulative_grad_steps = state["cumulative_grad_steps"] if state else 0
+
+    obs, _ = envs.reset(seed=cfg.seed)
+    obs_vec = flatten_obs(obs, mlp_keys, num_envs)
+
+    while policy_step < total_steps:
+        with timer("Time/env_interaction_time"):
+            if policy_step <= learning_starts:
+                env_actions = np.stack([action_space.sample() for _ in range(num_envs)])
+            else:
+                root_key, k = jax.random.split(root_key)
+                env_actions = np.asarray(act(params["actor"], jnp.asarray(obs_vec), k)).reshape(
+                    num_envs, act_dim
+                )
+            next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
+            policy_step += num_envs
+
+            # true next obs for the buffer: the final obs on done envs
+            real_next = flatten_obs(next_obs, mlp_keys, num_envs).copy()
+            if "final_obs" in info:
+                for i, fo in enumerate(info["final_obs"]):
+                    if fo is not None:
+                        real_next[i] = np.concatenate(
+                            [np.asarray(fo[k], np.float32).reshape(-1) for k in mlp_keys]
+                        )
+
+            step_data = {
+                "observations": obs_vec.reshape(1, num_envs, -1),
+                "next_observations": real_next.reshape(1, num_envs, -1),
+                "actions": env_actions.reshape(1, num_envs, act_dim).astype(np.float32),
+                "rewards": np.asarray(rewards, np.float32).reshape(1, num_envs, 1),
+                "terminated": np.asarray(terminated, np.float32).reshape(1, num_envs, 1),
+                "dones": np.logical_or(terminated, truncated).astype(np.float32).reshape(1, num_envs, 1),
+            }
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            obs_vec = flatten_obs(next_obs, mlp_keys, num_envs)
+
+            for ep_rew, ep_len in episode_stats(info):
+                aggregator.update("Rewards/rew_avg", ep_rew)
+                aggregator.update("Game/ep_len_avg", ep_len)
+
+        if policy_step >= learning_starts:
+            per_rank_gradient_steps = ratio(policy_step / dist.world_size)
+            if per_rank_gradient_steps > 0:
+                with timer("Time/train_time"):
+                    sample = rb.sample(
+                        batch_size * per_rank_gradient_steps,
+                        sample_next_obs=False,
+                        n_samples=1,
+                    )
+                    mb_sharding = dist.sharding(None, "dp")  # [G, B, ...] — shard batch axis
+                    batches = {
+                        k: jax.device_put(
+                            np.asarray(v).reshape(per_rank_gradient_steps, batch_size, *v.shape[2:]),
+                            mb_sharding,
+                        )
+                        for k, v in sample.items()
+                    }
+                    root_key, sub = jax.random.split(root_key)
+                    keys = jax.random.split(sub, per_rank_gradient_steps)
+                    params, opt_states, metrics = train(params, opt_states, batches, keys)
+                    cumulative_grad_steps += per_rank_gradient_steps
+                for k, v in metrics.items():
+                    aggregator.update(k, np.asarray(v))
+
+        if rank == 0 and logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
+            logger.log_metrics(aggregator.compute(), policy_step)
+            aggregator.reset()
+            timings = timer.compute()
+            if timings.get("Time/train_time"):
+                logger.log_metrics(
+                    {"Time/sps_train": (policy_step - last_log) / timings["Time/train_time"]}, policy_step
+                )
+            if policy_step > 0:
+                logger.log_metrics(
+                    {"Params/replay_ratio": cumulative_grad_steps * dist.world_size / policy_step},
+                    policy_step,
+                )
+            timer.reset()
+            last_log = policy_step
+
+        if (
+            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
+        ) or cfg.dry_run or policy_step >= total_steps:
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "params": params,
+                "opt_states": opt_states,
+                "ratio": ratio.state_dict(),
+                "policy_step": policy_step,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+                "cumulative_grad_steps": cumulative_grad_steps,
+                "rng": root_key,
+            }
+            if cfg.buffer.checkpoint:
+                ckpt_state["rb"] = rb.state_dict()
+            ckpt.save(policy_step, ckpt_state)
+
+    envs.close()
+    if rank == 0 and cfg.algo.run_test:
+        test_env = vectorize(
+            Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}}), cfg.seed, rank, log_dir
+        ).envs[0]
+        test(actor, params["actor"], test_env, cfg, log_dir, logger)
+    if rank == 0 and not cfg.model_manager.disabled:
+        from ...utils.model_manager import register_model
+
+        register_model(cfg, {"actor": params["actor"], "critic": params["critic"]}, log_dir)
+    if logger is not None:
+        logger.close()
+
+
+@register_evaluation(algorithms="sac")
+def evaluate_sac(dist: Distributed, cfg: Config, state: Dict[str, Any]) -> None:
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
+    logger = get_logger(cfg, log_dir, dist.process_index)
+    env = vectorize(cfg, cfg.seed, 0, log_dir).envs[0]
+    root_key = dist.seed_everything(cfg.seed)
+    actor, critic, params = build_agent(
+        dist, cfg, env.observation_space, env.action_space, root_key, state["params"]
+    )
+    test(actor, params["actor"], env, cfg, log_dir, logger)

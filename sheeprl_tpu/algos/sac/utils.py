@@ -1,0 +1,58 @@
+"""SAC per-algo contract (reference sheeprl/algos/sac/utils.py)."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def flatten_obs(obs: Dict[str, np.ndarray], mlp_keys, num_envs: int) -> np.ndarray:
+    """Concatenate vector keys into one [N, D] float array."""
+    return np.concatenate(
+        [np.asarray(obs[k], np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
+    )
+
+
+def prepare_obs(obs: Dict[str, np.ndarray], mlp_keys, num_envs: int = 1) -> jax.Array:
+    return jnp.asarray(flatten_obs(obs, mlp_keys, num_envs))
+
+
+def test(actor, actor_params, env, cfg, log_dir: str, logger=None) -> float:
+    """Greedy (mean-action) single-episode rollout (reference sac/utils.py)."""
+    from .agent import sample_actions
+
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+
+    @jax.jit
+    def act(p, o):
+        mean, log_std = actor.apply({"params": p}, o)
+        actions, _ = sample_actions(actor, mean, log_std, None, greedy=True)
+        return actions
+
+    done = False
+    cumulative_rew = 0.0
+    obs, _ = env.reset(seed=cfg.seed)
+    while not done:
+        o = prepare_obs(obs, mlp_keys, 1)
+        actions = np.asarray(act(actor_params, o)).reshape(env.action_space.shape)
+        obs, reward, terminated, truncated, _ = env.step(actions)
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.get("dry_run", False):
+            done = True
+    if logger is not None:
+        logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    print(f"Test - Reward: {cumulative_rew}")
+    env.close()
+    return cumulative_rew

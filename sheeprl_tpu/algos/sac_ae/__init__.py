@@ -1,0 +1,1 @@
+from . import sac_ae  # noqa: F401 — registers the algorithm + evaluation

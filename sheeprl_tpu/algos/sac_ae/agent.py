@@ -1,0 +1,293 @@
+"""SAC-AE agent (reference sheeprl/algos/sac_ae/agent.py, 640 LoC).
+
+Pixel SAC with an autoencoder (https://arxiv.org/abs/1910.01741):
+* `SACAEEncoder` — 4×conv(32·m, k3, strides 2,1,1,1) + Dense(features_dim) +
+  LayerNorm + tanh for image keys (reference CNNEncoder :26-87), plus an MLP
+  branch for vector keys (:89-120); `detach_conv` cuts gradients at the conv
+  output for the actor path (:81-83).
+* `SACAECNNDecoder` — Dense → deconv mirror → per-key channel split
+  (:153-202). NHWC; the final 63→64 comes from an explicit pad (flax
+  ConvTranspose has no output_padding).
+* Q ensemble vmapped as in SAC; actor is the SAC actor over encoder features.
+
+Param pytree: {encoder, qs, actor, decoder, target_encoder, target_qs,
+log_alpha} — the reference's module soup (SACAEAgent :321-640, EMA helpers)
+becomes plain tree ops.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models import MLP, LayerNorm
+from ..sac.agent import LOG_STD_MAX, LOG_STD_MIN
+
+
+class SACAECNNEncoder(nn.Module):
+    keys: Sequence[str]
+    features_dim: int
+    channels_multiplier: int = 1
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array], detach_conv: bool = False) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        m = 32 * self.channels_multiplier
+        for i, stride in enumerate((2, 1, 1, 1)):
+            x = nn.relu(
+                nn.Conv(m, (3, 3), strides=(stride, stride), padding="VALID", name=f"conv_{i}")(x)
+            )
+        x = jnp.reshape(x, x.shape[:-3] + (-1,))
+        if detach_conv:
+            x = jax.lax.stop_gradient(x)
+        x = nn.Dense(self.features_dim, name="fc")(x)
+        x = LayerNorm()(x)
+        return jnp.tanh(x)
+
+
+class SACAEMLPEncoder(nn.Module):
+    keys: Sequence[str]
+    dense_units: int = 64
+    mlp_layers: int = 2
+    layer_norm: bool = False
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array], detach_conv: bool = False) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        return MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation="relu",
+            norm_layer="layernorm" if self.layer_norm else None,
+        )(x)
+
+
+class SACAEEncoder(nn.Module):
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    features_dim: int
+    channels_multiplier: int = 1
+    dense_units: int = 64
+    mlp_layers: int = 2
+    layer_norm: bool = False
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array], detach_conv: bool = False) -> jax.Array:
+        feats = []
+        if self.cnn_keys:
+            feats.append(
+                SACAECNNEncoder(self.cnn_keys, self.features_dim, self.channels_multiplier)(
+                    obs, detach_conv
+                )
+            )
+        if self.mlp_keys:
+            feats.append(
+                SACAEMLPEncoder(self.mlp_keys, self.dense_units, self.mlp_layers, self.layer_norm)(obs)
+            )
+        return jnp.concatenate(feats, axis=-1)
+
+
+class SACAECNNDecoder(nn.Module):
+    keys: Sequence[str]
+    key_channels: Sequence[int]
+    conv_output_shape: Tuple[int, int, int]  # (H, W, C) of the encoder convs
+    channels_multiplier: int = 1
+    screen_size: int = 64
+
+    @nn.compact
+    def __call__(self, features: jax.Array) -> Dict[str, jax.Array]:
+        m = 32 * self.channels_multiplier
+        h, w, c = self.conv_output_shape
+        x = nn.Dense(h * w * c, name="fc")(features)
+        x = jnp.reshape(x, x.shape[:-1] + (h, w, c))
+        for i in range(3):
+            x = nn.relu(
+                nn.ConvTranspose(m, (3, 3), strides=(1, 1), padding="VALID", name=f"deconv_{i}")(x)
+            )
+        x = nn.ConvTranspose(sum(self.key_channels), (3, 3), strides=(2, 2), padding="VALID", name="to_obs")(x)
+        # torch output_padding=1 equivalent: pad one row/col to reach screen_size
+        pad_h = self.screen_size - x.shape[-3]
+        pad_w = self.screen_size - x.shape[-2]
+        if pad_h > 0 or pad_w > 0:
+            x = jnp.pad(x, [(0, 0)] * (x.ndim - 3) + [(0, pad_h), (0, pad_w), (0, 0)])
+        out: Dict[str, jax.Array] = {}
+        start = 0
+        for k, ch in zip(self.keys, self.key_channels):
+            out[k] = x[..., start : start + ch]
+            start += ch
+        return out
+
+
+class SACAEMLPDecoder(nn.Module):
+    keys: Sequence[str]
+    output_dims: Sequence[int]
+    dense_units: int = 64
+    mlp_layers: int = 2
+
+    @nn.compact
+    def __call__(self, features: jax.Array) -> Dict[str, jax.Array]:
+        x = MLP(hidden_sizes=(self.dense_units,) * self.mlp_layers, activation="relu")(features)
+        return {k: nn.Dense(d, name=f"head_{k}")(x) for k, d in zip(self.keys, self.output_dims)}
+
+
+class SACAEDecoder(nn.Module):
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    key_channels: Sequence[int]
+    mlp_output_dims: Sequence[int]
+    conv_output_shape: Tuple[int, int, int]
+    channels_multiplier: int = 1
+    screen_size: int = 64
+    dense_units: int = 64
+    mlp_layers: int = 2
+
+    @nn.compact
+    def __call__(self, features: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_keys:
+            out.update(
+                SACAECNNDecoder(
+                    self.cnn_keys,
+                    self.key_channels,
+                    self.conv_output_shape,
+                    self.channels_multiplier,
+                    self.screen_size,
+                )(features)
+            )
+        if self.mlp_keys:
+            out.update(
+                SACAEMLPDecoder(self.mlp_keys, self.mlp_output_dims, self.dense_units, self.mlp_layers)(features)
+            )
+        return out
+
+
+class SACAEQFunction(nn.Module):
+    """Q(features, a) (reference :204-238)."""
+
+    hidden_size: int = 1024
+
+    @nn.compact
+    def __call__(self, features: jax.Array, action: jax.Array) -> jax.Array:
+        x = jnp.concatenate([features, action], axis=-1)
+        return MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size), output_dim=1, activation="relu"
+        )(x)
+
+
+def make_q_ensemble(hidden_size: int, n: int) -> nn.Module:
+    return nn.vmap(
+        SACAEQFunction,
+        in_axes=None,
+        out_axes=0,
+        axis_size=n,
+        variable_axes={"params": 0},
+        split_rngs={"params": True},
+    )(hidden_size=hidden_size)
+
+
+class SACAEActor(nn.Module):
+    """Squashed-Gaussian actor over encoder features (reference :240-319)."""
+
+    action_dim: int
+    hidden_size: int = 1024
+    action_low: Any = -1.0
+    action_high: Any = 1.0
+
+    @nn.compact
+    def __call__(self, features: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = MLP(hidden_sizes=(self.hidden_size, self.hidden_size), activation="relu")(features)
+        mean = nn.Dense(self.action_dim, name="fc_mean")(x)
+        log_std = nn.Dense(self.action_dim, name="fc_logstd")(x)
+        return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    @property
+    def action_scale(self) -> jax.Array:
+        return jnp.asarray((np.asarray(self.action_high) - np.asarray(self.action_low)) / 2.0, jnp.float32)
+
+    @property
+    def action_bias(self) -> jax.Array:
+        return jnp.asarray((np.asarray(self.action_high) + np.asarray(self.action_low)) / 2.0, jnp.float32)
+
+
+def conv_output_shape(screen_size: int, channels_multiplier: int) -> Tuple[int, int, int]:
+    s = (screen_size - 3) // 2 + 1
+    for _ in range(3):
+        s = s - 2
+    return (s, s, 32 * channels_multiplier)
+
+
+def build_agent(
+    dist: Any,
+    cfg: Any,
+    observation_space: gym.spaces.Dict,
+    action_space: gym.spaces.Box,
+    key: jax.Array,
+    state: Optional[Dict[str, Any]] = None,
+):
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError(f"SAC-AE supports continuous (Box) actions only, got {action_space}")
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    act_dim = int(np.prod(action_space.shape))
+    screen = int(cfg.env.screen_size)
+    mult = int(cfg.algo.cnn_channels_multiplier)
+
+    encoder = SACAEEncoder(
+        cnn_keys=cnn_keys,
+        mlp_keys=mlp_keys,
+        features_dim=cfg.algo.encoder.features_dim,
+        channels_multiplier=mult,
+        dense_units=cfg.algo.dense_units,
+        mlp_layers=cfg.algo.mlp_layers,
+        layer_norm=cfg.algo.layer_norm,
+    )
+    key_channels = [observation_space[k].shape[-1] for k in cnn_keys]
+    mlp_dims = [int(np.prod(observation_space[k].shape)) for k in mlp_keys]
+    decoder = SACAEDecoder(
+        cnn_keys=cnn_keys,
+        mlp_keys=mlp_keys,
+        key_channels=key_channels,
+        mlp_output_dims=mlp_dims,
+        conv_output_shape=conv_output_shape(screen, mult),
+        channels_multiplier=mult,
+        screen_size=screen,
+        dense_units=cfg.algo.dense_units,
+        mlp_layers=cfg.algo.mlp_layers,
+    )
+    qs = make_q_ensemble(cfg.algo.hidden_size, int(cfg.algo.critic.n))
+    actor = SACAEActor(
+        action_dim=act_dim,
+        hidden_size=cfg.algo.hidden_size,
+        action_low=action_space.low.tolist(),
+        action_high=action_space.high.tolist(),
+    )
+
+    if state is not None:
+        params = state
+    else:
+        ke, kq, ka, kd = jax.random.split(key, 4)
+        dummy_obs = {}
+        for k in cnn_keys:
+            dummy_obs[k] = jnp.zeros((1,) + tuple(observation_space[k].shape), jnp.float32)
+        for k in mlp_keys:
+            dummy_obs[k] = jnp.zeros((1, int(np.prod(observation_space[k].shape))), jnp.float32)
+        enc_params = encoder.init(ke, dummy_obs)["params"]
+        feat_dim = int(
+            encoder.apply({"params": enc_params}, dummy_obs).shape[-1]
+        )
+        dummy_feat = jnp.zeros((1, feat_dim))
+        dummy_act = jnp.zeros((1, act_dim))
+        params = {
+            "encoder": enc_params,
+            "qs": qs.init(kq, dummy_feat, dummy_act)["params"],
+            "actor": actor.init(ka, dummy_feat)["params"],
+            "decoder": decoder.init(kd, dummy_feat)["params"],
+            "log_alpha": jnp.asarray(jnp.log(cfg.algo.alpha.alpha), jnp.float32),
+        }
+        params["target_encoder"] = jax.tree.map(jnp.copy, params["encoder"])
+        params["target_qs"] = jax.tree.map(jnp.copy, params["qs"])
+    params = dist.replicate(params)
+    return encoder, decoder, qs, actor, params

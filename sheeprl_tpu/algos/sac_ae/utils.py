@@ -1,0 +1,77 @@
+"""SAC-AE per-algo contract (reference sheeprl/algos/sac_ae/utils.py)."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+    "Loss/reconstruction_loss",
+}
+MODELS_TO_REGISTER = {"agent", "encoder", "decoder"}
+
+
+def preprocess_obs(obs: jax.Array, bits: int = 8, key: Optional[jax.Array] = None) -> jax.Array:
+    """Bit-depth reduction + dequantization noise (reference sac_ae/utils.py:
+    68-76, from https://arxiv.org/abs/1807.03039)."""
+    bins = 2**bits
+    obs = obs.astype(jnp.float32)
+    if bits < 8:
+        obs = jnp.floor(obs / 2 ** (8 - bits))
+    obs = obs / bins
+    if key is not None:
+        obs = obs + jax.random.uniform(key, obs.shape) / bins
+    return obs - 0.5
+
+
+def sample_actions_features(actor, mean, log_std, key, greedy: bool = False):
+    """Same squashed-Gaussian path as SAC but for a feature-space actor."""
+    from ..sac.agent import sample_actions
+
+    return sample_actions(actor, mean, log_std, key, greedy=greedy)
+
+
+def prepare_obs_np(obs: Dict[str, np.ndarray], cnn_keys, mlp_keys, num_envs: int, normalize: bool = False):
+    out = {}
+    for k in cnn_keys:
+        x = jnp.asarray(np.asarray(obs[k]).reshape(num_envs, *np.asarray(obs[k]).shape[-3:]))
+        out[k] = x.astype(jnp.float32) / 255.0 if normalize else x
+    for k in mlp_keys:
+        out[k] = jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1))
+    return out
+
+
+def test(encoder, actor, params, env, cfg, log_dir: str, logger=None) -> float:
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+
+    @jax.jit
+    def act(p, o):
+        feat = encoder.apply({"params": p["encoder"]}, o)
+        mean, log_std = actor.apply({"params": p["actor"]}, feat)
+        actions, _ = sample_actions_features(actor, mean, log_std, None, greedy=True)
+        return actions
+
+    done = False
+    cumulative_rew = 0.0
+    obs, _ = env.reset(seed=cfg.seed)
+    while not done:
+        o = prepare_obs_np(obs, cnn_keys, mlp_keys, 1, normalize=True)
+        actions = np.asarray(act(params, o)).reshape(env.action_space.shape)
+        obs, reward, terminated, truncated, _ = env.step(actions)
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.get("dry_run", False):
+            done = True
+    if logger is not None:
+        logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    print(f"Test - Reward: {cumulative_rew}")
+    env.close()
+    return cumulative_rew

@@ -26,16 +26,16 @@ def two_hot_encoder(x: jax.Array, support_range: int = 300, num_buckets: int = 2
     in symlog space; values land fractionally between the two nearest bins.
     Input [..., 1] → output [..., num_buckets].
     """
-    x = symlog(x)
+    x = symlog(x)[..., 0]  # drop the size-1 scalar dim: [...]
     support = jnp.linspace(-support_range, support_range, num_buckets)
     x = jnp.clip(x, -support_range, support_range)
-    idx_low = jnp.sum(support[None, :] <= x[..., :], axis=-1) - 1
+    idx_low = jnp.sum(support <= x[..., None], axis=-1) - 1
     idx_low = jnp.clip(idx_low, 0, num_buckets - 1)
     idx_high = jnp.clip(idx_low + 1, 0, num_buckets - 1)
     low_val = support[idx_low]
     high_val = support[idx_high]
     denom = high_val - low_val
-    frac = jnp.where(denom > 0, (x[..., 0] - low_val) / jnp.where(denom > 0, denom, 1.0), 0.0)
+    frac = jnp.where(denom > 0, (x - low_val) / jnp.where(denom > 0, denom, 1.0), 0.0)
     oh_low = jax.nn.one_hot(idx_low, num_buckets) * (1.0 - frac)[..., None]
     oh_high = jax.nn.one_hot(idx_high, num_buckets) * frac[..., None]
     return oh_low + oh_high

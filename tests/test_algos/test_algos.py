@@ -1,0 +1,98 @@
+"""End-to-end 1-iteration runs of every registered algorithm on CPU with the
+dummy envs — the integration backbone (reference tests/test_algos/test_algos.py,
+566 LoC: one test per algo, dry_run=True, tiny sizes, 2 envs)."""
+import pytest
+
+from sheeprl_tpu.cli import run
+
+
+def _run(args, standard_args):
+    run(args + standard_args)
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_ppo(standard_args, env_id):
+    _run(
+        [
+            "exp=ppo",
+            "env=dummy",
+            f"env.id={env_id}",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.encoder.cnn_features_dim=16",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+        ],
+        standard_args,
+    )
+
+
+def test_sac(standard_args):
+    _run(
+        [
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "algo.per_rank_batch_size=4",
+            "algo.hidden_size=8",
+            "algo.learning_starts=0",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.size=64",
+        ],
+        standard_args,
+    )
+
+
+def test_sac_ae(standard_args):
+    _run(
+        [
+            "exp=sac_ae",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "algo.per_rank_batch_size=4",
+            "algo.hidden_size=8",
+            "algo.dense_units=8",
+            "algo.cnn_channels_multiplier=1",
+            "algo.encoder.features_dim=8",
+            "algo.learning_starts=0",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.size=16",
+        ],
+        standard_args,
+    )
+
+
+def test_droq(standard_args):
+    _run(
+        [
+            "exp=droq",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "algo.per_rank_batch_size=4",
+            "algo.hidden_size=8",
+            "algo.learning_starts=0",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.size=64",
+        ],
+        standard_args,
+    )
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_a2c(standard_args, env_id):
+    _run(
+        [
+            "exp=a2c",
+            "env=dummy",
+            f"env.id={env_id}",
+            "algo.rollout_steps=4",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+        ],
+        standard_args,
+    )

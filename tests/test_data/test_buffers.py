@@ -1,0 +1,105 @@
+"""ReplayBuffer semantics tests (counterpart of reference
+tests/test_data/test_buffers.py:13-431: wrap-around, next-obs validity,
+memmap persistence)."""
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import ReplayBuffer
+
+
+def _mk_data(t, n, start=0):
+    steps = (start + np.arange(t)).reshape(t, 1, 1) * np.ones((t, n, 1))
+    return {"observations": steps.astype(np.float32), "dones": np.zeros((t, n, 1), np.float32)}
+
+
+def test_add_and_wraparound():
+    rb = ReplayBuffer(buffer_size=4, n_envs=2)
+    rb.add(_mk_data(3, 2))
+    assert not rb.full
+    rb.add(_mk_data(3, 2, start=3))
+    assert rb.full
+    # positions 0..3 hold (wrapped) steps 4,5,2,3
+    assert rb["observations"][:, 0, 0].tolist() == [4.0, 5.0, 2.0, 3.0]
+
+
+def test_add_longer_than_buffer():
+    rb = ReplayBuffer(buffer_size=3, n_envs=1)
+    rb.add(_mk_data(8, 1))
+    assert rb.full
+    stored = sorted(rb["observations"][:, 0, 0].tolist())
+    assert stored == [5.0, 6.0, 7.0]
+
+
+def test_add_validate_args():
+    rb = ReplayBuffer(buffer_size=4, n_envs=2)
+    with pytest.raises(ValueError):
+        rb.add([1, 2], validate_args=True)  # type: ignore[arg-type]
+    with pytest.raises(RuntimeError):
+        rb.add({"a": np.zeros((3, 1, 1))}, validate_args=True)  # wrong n_envs
+
+
+def test_sample_shapes():
+    rb = ReplayBuffer(buffer_size=8, n_envs=2)
+    rb.add(_mk_data(8, 2))
+    out = rb.sample(5, n_samples=3)
+    assert out["observations"].shape == (3, 5, 1)
+
+
+def test_sample_empty_raises():
+    rb = ReplayBuffer(buffer_size=4, n_envs=1)
+    with pytest.raises(ValueError):
+        rb.sample(1)
+
+
+def test_sample_next_obs_never_crosses_write_head():
+    """When full, the transition at pos-1 has its successor overwritten — it
+    must never be sampled with sample_next_obs (reference buffers.py:249-252)."""
+    rb = ReplayBuffer(buffer_size=4, n_envs=1)
+    rb.add(_mk_data(6, 1))  # stored [4,5,2,3], pos=2 → invalid idx=1 (obs 5)
+    np.random.seed(0)
+    for _ in range(20):
+        out = rb.sample(16, sample_next_obs=True)
+        obs = out["observations"][..., 0]
+        nxt = out["next_observations"][..., 0]
+        # successor of 5 would wrongly be 2 (the oldest entry)
+        assert not np.any((obs == 5.0)), f"invalid transition sampled: {obs}"
+        # all other successor relationships are consecutive
+        assert np.all((nxt - obs == 1) | ((obs == 5.0))), (obs, nxt)
+
+
+def test_sample_next_obs_not_full():
+    rb = ReplayBuffer(buffer_size=10, n_envs=1)
+    rb.add(_mk_data(5, 1))
+    out = rb.sample(32, sample_next_obs=True)
+    obs = out["observations"][..., 0]
+    nxt = out["next_observations"][..., 0]
+    assert np.all(nxt - obs == 1)
+    assert obs.max() <= 3  # pos-1 excluded
+
+
+def test_memmap_persistence(tmp_path):
+    d = tmp_path / "mm"
+    rb = ReplayBuffer(buffer_size=4, n_envs=1, memmap=True, memmap_dir=d)
+    rb.add(_mk_data(4, 1))
+    arr = np.asarray(rb["observations"]).copy()
+    files = list(d.glob("*.memmap"))
+    assert files
+    reopened = np.memmap(files[0].parent / "observations.memmap", dtype=np.float32, shape=(4, 1, 1))
+    np.testing.assert_array_equal(np.asarray(reopened), arr)
+
+
+def test_state_dict_roundtrip():
+    rb = ReplayBuffer(buffer_size=4, n_envs=2)
+    rb.add(_mk_data(6, 2))
+    state = rb.state_dict()
+    rb2 = ReplayBuffer.from_state_dict(state)
+    np.testing.assert_array_equal(rb2["observations"], rb["observations"])
+    assert rb2.full == rb.full
+
+
+def test_getitem_setitem():
+    rb = ReplayBuffer(buffer_size=4, n_envs=2)
+    rb["custom"] = np.ones((4, 2, 3), np.float32)
+    assert rb["custom"].shape == (4, 2, 3)
+    with pytest.raises(ValueError):
+        rb["bad"] = np.ones((2, 2))

@@ -1,0 +1,69 @@
+"""EpisodeBuffer tests (reference tests/test_data/test_episode_buffer.py:
+boundary splitting, eviction, minimum length, sampling)."""
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import EpisodeBuffer
+
+
+def _steps(t, n, done_at=None):
+    term = np.zeros((t, n, 1), np.float32)
+    if done_at is not None:
+        term[done_at] = 1.0
+    return {
+        "observations": np.arange(t, dtype=np.float32).reshape(t, 1, 1) * np.ones((t, n, 1)),
+        "terminated": term,
+        "truncated": np.zeros((t, n, 1), np.float32),
+    }
+
+
+def test_requires_done_keys():
+    eb = EpisodeBuffer(buffer_size=16)
+    with pytest.raises(RuntimeError):
+        eb.add({"observations": np.zeros((4, 1, 1))})
+
+
+def test_episode_splitting():
+    eb = EpisodeBuffer(buffer_size=32, n_envs=1)
+    eb.add(_steps(10, 1, done_at=4))  # one episode of 5, one still open
+    assert len(eb.buffer) == 1
+    assert len(next(iter(eb.buffer[0].values()))) == 5
+    eb.add(_steps(3, 1, done_at=2))  # closes the open episode (5+3=8 steps)
+    assert len(eb.buffer) == 2
+    assert len(eb) == 13
+
+
+def test_minimum_episode_length_filtering():
+    eb = EpisodeBuffer(buffer_size=32, minimum_episode_length=4, n_envs=1)
+    eb.add(_steps(3, 1, done_at=2))  # too short, dropped
+    assert len(eb.buffer) == 0
+
+
+def test_eviction():
+    eb = EpisodeBuffer(buffer_size=10, n_envs=1)
+    for _ in range(4):
+        eb.add(_steps(4, 1, done_at=3))
+    assert len(eb) <= 10
+    assert len(eb.buffer) == 2
+
+
+def test_sample_shapes_and_windows():
+    eb = EpisodeBuffer(buffer_size=64, n_envs=1)
+    eb.add(_steps(20, 1, done_at=19))
+    out = eb.sample(6, sequence_length=5, n_samples=2)
+    assert out["observations"].shape == (2, 5, 6, 1)
+    diffs = np.diff(out["observations"][..., 0], axis=1)
+    assert np.all(diffs == 1)
+
+
+def test_sample_no_long_episode_raises():
+    eb = EpisodeBuffer(buffer_size=64, n_envs=1)
+    eb.add(_steps(4, 1, done_at=3))
+    with pytest.raises(RuntimeError):
+        eb.sample(1, sequence_length=10)
+
+
+def test_oversized_episode_raises():
+    eb = EpisodeBuffer(buffer_size=5, n_envs=1)
+    with pytest.raises(RuntimeError):
+        eb.add(_steps(8, 1, done_at=7))

@@ -1,0 +1,38 @@
+"""MemmapArray tests (reference tests/test_data/test_memmap.py: ownership,
+pickling, ndarray protocol)."""
+import pickle
+
+import numpy as np
+
+from sheeprl_tpu.data import MemmapArray
+
+
+def test_basic_io(tmp_path):
+    m = MemmapArray((4, 3), dtype=np.float32, filename=tmp_path / "a.memmap")
+    m[0] = np.ones(3)
+    assert np.asarray(m)[0].sum() == 3
+    assert len(m) == 4 and m.shape == (4, 3)
+
+
+def test_from_array_and_ufunc(tmp_path):
+    src = np.arange(6, dtype=np.float32).reshape(2, 3)
+    m = MemmapArray.from_array(src, filename=tmp_path / "b.memmap")
+    np.testing.assert_array_equal(np.asarray(m + 1), src + 1)
+
+
+def test_pickle_shares_file_without_ownership(tmp_path):
+    m = MemmapArray((2, 2), dtype=np.int32, filename=tmp_path / "c.memmap")
+    m[:] = 7
+    m2 = pickle.loads(pickle.dumps(m))
+    assert not m2.has_ownership
+    np.testing.assert_array_equal(np.asarray(m2), np.asarray(m))
+    m2[0, 0] = 99  # writes through to the same file
+    assert m[0, 0] == 99
+
+
+def test_ownership_cleanup(tmp_path):
+    path = tmp_path / "d.memmap"
+    m = MemmapArray((2,), filename=path)
+    assert path.exists()
+    del m
+    assert not path.exists()

@@ -1,0 +1,47 @@
+"""SequentialReplayBuffer tests (reference tests/test_data/test_sequential_buffer.py)."""
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import EnvIndependentReplayBuffer, SequentialReplayBuffer
+
+
+def _mk_data(t, n, start=0):
+    steps = (start + np.arange(t)).reshape(t, 1, 1) * np.ones((t, n, 1))
+    return {"observations": steps.astype(np.float32)}
+
+
+def test_sample_sequences_shape_and_contiguity():
+    rb = SequentialReplayBuffer(buffer_size=16, n_envs=2)
+    rb.add(_mk_data(16, 2))
+    out = rb.sample(4, sequence_length=5, n_samples=3)
+    seqs = out["observations"]
+    assert seqs.shape == (3, 5, 4, 1)
+    diffs = np.diff(seqs[..., 0], axis=1)
+    assert np.all(diffs == 1)
+
+
+def test_sample_wrapped_sequences_never_cross_head():
+    rb = SequentialReplayBuffer(buffer_size=8, n_envs=1)
+    rb.add(_mk_data(13, 1))  # pos=5, stored [8,9,10,11,12,5,6,7]
+    np.random.seed(1)
+    out = rb.sample(64, sequence_length=3)
+    seqs = out["observations"][0, ..., 0]  # [L, batch] → check contiguity
+    diffs = np.diff(seqs, axis=0)
+    assert np.all(diffs == 1), seqs.T[np.any(diffs != 1, axis=0)]
+
+
+def test_sample_too_long_raises():
+    rb = SequentialReplayBuffer(buffer_size=8, n_envs=1)
+    rb.add(_mk_data(4, 1))
+    with pytest.raises(ValueError):
+        rb.sample(1, sequence_length=6)
+
+
+def test_env_independent_buffer_per_env_add():
+    rb = EnvIndependentReplayBuffer(buffer_size=8, n_envs=3, buffer_cls=SequentialReplayBuffer)
+    data = _mk_data(4, 2)
+    rb.add(data, indices=[0, 2])
+    assert not rb.buffer[1].full and rb.buffer[1]._pos == 0
+    assert rb.buffer[0]._pos == 4 and rb.buffer[2]._pos == 4
+    out = rb.sample(6, sequence_length=2)
+    assert out["observations"].shape == (1, 2, 6, 1)

@@ -1,0 +1,82 @@
+"""Model-library tests (reference tests/test_models/test_mlp.py etc.)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.models import CNN, DeCNN, LayerNormGRUCell, MLP, MultiEncoder, NatureCNN
+
+
+def test_mlp_shapes_and_output_dim():
+    m = MLP(hidden_sizes=(32, 32), output_dim=5, activation="relu")
+    params = m.init(jax.random.key(0), jnp.zeros((4, 10)))
+    out = m.apply(params, jnp.ones((4, 10)))
+    assert out.shape == (4, 5)
+
+
+def test_mlp_flatten_dim():
+    m = MLP(hidden_sizes=(8,), flatten_dim=1)
+    params = m.init(jax.random.key(0), jnp.zeros((4, 3, 5)))
+    out = m.apply(params, jnp.ones((4, 3, 5)))
+    assert out.shape == (4, 8)
+
+
+def test_mlp_layernorm():
+    m = MLP(hidden_sizes=(16,), norm_layer="layernorm", activation="tanh")
+    params = m.init(jax.random.key(0), jnp.zeros((2, 4)))
+    out = m.apply(params, jnp.ones((2, 4)) * 100)
+    assert np.all(np.abs(np.asarray(out)) <= 1.0)  # tanh after LN
+
+
+def test_cnn_and_decnn_shapes():
+    cnn = CNN(channels=(8, 16), kernel_sizes=(4,), strides=(2,))
+    params = cnn.init(jax.random.key(0), jnp.zeros((2, 16, 16, 3)))
+    out = cnn.apply(params, jnp.ones((2, 16, 16, 3)))
+    assert out.shape == (2, 4, 4, 16)
+    de = DeCNN(channels=(8, 3), kernel_sizes=(4,), strides=(2,))
+    dparams = de.init(jax.random.key(0), out)
+    rec = de.apply(dparams, out)
+    assert rec.shape == (2, 16, 16, 3)
+
+
+def test_nature_cnn_output():
+    m = NatureCNN(features_dim=64)
+    params = m.init(jax.random.key(0), jnp.zeros((2, 64, 64, 3), jnp.uint8))
+    out = m.apply(params, jnp.ones((2, 64, 64, 3), jnp.uint8))
+    assert out.shape == (2, 64)
+
+
+def test_nature_cnn_leading_dims():
+    m = NatureCNN(features_dim=32)
+    params = m.init(jax.random.key(0), jnp.zeros((2, 3, 64, 64, 1), jnp.uint8))
+    out = m.apply(params, jnp.zeros((2, 3, 64, 64, 1), jnp.uint8))
+    assert out.shape == (2, 3, 32)
+
+
+def test_layernorm_gru_cell_scan():
+    cell = LayerNormGRUCell(hidden_size=16)
+    x = jnp.ones((4, 8))
+    h = jnp.zeros((4, 16))
+    params = cell.init(jax.random.key(0), h, x)
+
+    def step(carry, inp):
+        new_h, out = cell.apply(params, carry, inp)
+        return new_h, out
+
+    xs = jnp.ones((10, 4, 8))
+    final_h, outs = jax.lax.scan(step, h, xs)
+    assert final_h.shape == (4, 16)
+    assert outs.shape == (10, 4, 16)
+    assert not np.allclose(np.asarray(final_h), 0)
+
+
+def test_multi_encoder_concat():
+    class VecEnc(nn_module := __import__("flax.linen", fromlist=["Module"]).Module):
+        @__import__("flax.linen", fromlist=["compact"]).compact
+        def __call__(self, obs):
+            return obs["state"] * 2
+
+    enc = MultiEncoder(cnn_encoder=None, mlp_encoder=VecEnc())
+    params = enc.init(jax.random.key(0), {"state": jnp.ones((2, 3))})
+    out = enc.apply(params, {"state": jnp.ones((2, 3))})
+    assert out.shape == (2, 3)
