@@ -1,0 +1,1 @@
+from . import dreamer_v3  # noqa: F401 — registers the algorithm + evaluation

@@ -1,0 +1,64 @@
+"""DreamerV3 world-model loss (reference sheeprl/algos/dreamer_v3/loss.py).
+
+Eq. 5 of https://arxiv.org/abs/2301.04104: observation (MSE/symlog) + reward
+(two-hot) + continue (Bernoulli) log-likelihoods plus KL-balanced dynamics/
+representation losses with free nats. All in f32 (bf16-sensitive path,
+SURVEY.md §7).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...distributions import (
+    Distribution,
+    Independent,
+    OneHotCategoricalStraightThrough,
+    kl_divergence,
+)
+
+
+def reconstruction_loss(
+    po: Dict[str, Distribution],
+    observations: Dict[str, jax.Array],
+    pr: Distribution,
+    rewards: jax.Array,
+    priors_logits: jax.Array,  # [T, B, S, D]
+    posteriors_logits: jax.Array,
+    kl_dynamic: float = 0.5,
+    kl_representation: float = 0.1,
+    kl_free_nats: float = 1.0,
+    kl_regularizer: float = 1.0,
+    pc: Optional[Distribution] = None,
+    continue_targets: Optional[jax.Array] = None,
+    continue_scale_factor: float = 1.0,
+) -> Tuple[jax.Array, ...]:
+    observation_loss = -sum(po[k].log_prob(observations[k]) for k in po)
+    reward_loss = -pr.log_prob(rewards)
+    dyn_loss = kl = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=jax.lax.stop_gradient(posteriors_logits)), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=priors_logits), 1),
+    )
+    free_nats = jnp.full_like(dyn_loss, kl_free_nats)
+    dyn_loss = kl_dynamic * jnp.maximum(dyn_loss, free_nats)
+    repr_loss = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=posteriors_logits), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=jax.lax.stop_gradient(priors_logits)), 1),
+    )
+    repr_loss = kl_representation * jnp.maximum(repr_loss, free_nats)
+    kl_loss = dyn_loss + repr_loss
+    if pc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * -pc.log_prob(continue_targets)
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    rec_loss = jnp.mean(kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss)
+    return (
+        rec_loss,
+        jnp.mean(kl),
+        jnp.mean(kl_loss),
+        jnp.mean(reward_loss),
+        jnp.mean(observation_loss),
+        jnp.mean(continue_loss),
+    )

@@ -1,0 +1,107 @@
+"""DreamerV3 per-algo contract (reference sheeprl/algos/dreamer_v3/utils.py).
+
+`Moments` is a pure pytree (low/high EMA of return percentiles) updated
+functionally inside the jitted train step; the reference's `fabric.all_gather`
+(:56-63) is unnecessary under the single JAX controller (the full batch is
+already visible) — multi-host runs get the same semantics because the batch
+is globally sharded and `jnp.quantile` runs on the global array.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moments"}
+
+
+class MomentsState(NamedTuple):
+    low: jax.Array
+    high: jax.Array
+
+
+def init_moments() -> MomentsState:
+    return MomentsState(low=jnp.zeros(()), high=jnp.zeros(()))
+
+
+def update_moments(
+    state: MomentsState,
+    x: jax.Array,
+    decay: float = 0.99,
+    max_: float = 1.0,
+    percentile_low: float = 0.05,
+    percentile_high: float = 0.95,
+) -> Tuple[MomentsState, jax.Array, jax.Array]:
+    """Returns (new_state, offset, invscale) (reference Moments.forward :52-63)."""
+    x = jax.lax.stop_gradient(x.astype(jnp.float32))
+    low = jnp.quantile(x, percentile_low)
+    high = jnp.quantile(x, percentile_high)
+    new_low = decay * state.low + (1 - decay) * low
+    new_high = decay * state.high + (1 - decay) * high
+    invscale = jnp.maximum(1.0 / max_, new_high - new_low)
+    return MomentsState(new_low, new_high), new_low, invscale
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], cnn_keys=(), mlp_keys=(), num_envs: int = 1
+) -> Dict[str, jax.Array]:
+    """Host obs → device: images stay uint8 (normalized in the encoder path),
+    vectors f32 (reference dreamer_v3/utils.py prepare_obs)."""
+    out: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        out[k] = jnp.asarray(np.asarray(obs[k]).reshape(num_envs, *np.asarray(obs[k]).shape[-3:]))
+    for k in mlp_keys:
+        out[k] = jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1))
+    return out
+
+
+def normalize_obs(obs: Dict[str, jax.Array], cnn_keys) -> Dict[str, jax.Array]:
+    return {k: (v.astype(jnp.float32) / 255.0 - 0.5) if k in cnn_keys else v for k, v in obs.items()}
+
+
+def test(player_step, player_state, env, cfg, log_dir: str, logger=None, seed=None) -> float:
+    """Greedy episode with the device-resident player (reference utils.py test)."""
+    done = False
+    cumulative_rew = 0.0
+    obs, _ = env.reset(seed=seed if seed is not None else cfg.seed)
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    key = jax.random.key(cfg.seed)
+    import gymnasium as gym
+
+    is_box = isinstance(env.action_space, gym.spaces.Box)
+    while not done:
+        device_obs = prepare_obs(obs, cnn_keys, mlp_keys, 1)
+        key, k = jax.random.split(key)
+        env_actions, player_state = player_step(device_obs, player_state, k, True)
+        acts = np.asarray(env_actions)
+        if is_box or isinstance(env.action_space, gym.spaces.MultiDiscrete):
+            step_action = acts.reshape(env.action_space.shape)
+        else:
+            step_action = acts.reshape(()).item()
+        obs, reward, terminated, truncated, _ = env.step(step_action)
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.get("dry_run", False):
+            done = True
+    if logger is not None:
+        logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    print(f"Test - Reward: {cumulative_rew}")
+    env.close()
+    return cumulative_rew

@@ -110,9 +110,15 @@ def _parse_default_entry(entry: Any) -> Tuple[Optional[str], Optional[str], bool
 def _compose_file(
     rel: str,
     roots: Sequence[Path],
-    package_overrides: Optional[Mapping[str, str]] = None,
+    choices: Optional[Mapping[str, str]] = None,
+    used_choices: Optional[set] = None,
 ) -> Config:
-    """Load ``rel`` (group path, no extension) and recursively compose its defaults."""
+    """Load ``rel`` (group path, no extension) and recursively compose its defaults.
+
+    ``choices`` maps group name → option selected on the CLI; a matching
+    defaults-list entry uses the CLI option instead of the file's (Hydra's
+    group-choice override semantics).
+    """
     path = _find_config(rel, roots)
     if path is None:
         raise FileNotFoundError(
@@ -137,6 +143,17 @@ def _compose_file(
         dest = None
         if "@" in group:
             group, dest = group.split("@", 1)
+        # CLI group choice supersedes the file's selection. Package-qualified
+        # entries (group@dest) are only matched by the package-qualified
+        # choice syntax `group@dest=option` (Hydra semantics: a bare override
+        # does not rewrite packaged entries).
+        if option is not None and choices:
+            plain = group.lstrip("/")
+            lookup = f"{plain}@{dest}" if dest is not None else plain
+            if lookup in choices:
+                option = choices[lookup]
+                if used_choices is not None:
+                    used_choices.add(lookup)
         if option is None:
             include_rel, dest_key = group, None
         else:
@@ -165,7 +182,7 @@ def _compose_file(
         last_err: Optional[Exception] = None
         for cand in candidates:
             try:
-                sub = _compose_file(cand, roots)
+                sub = _compose_file(cand, roots, choices, used_choices)
                 break
             except FileNotFoundError as e:
                 last_err = e
@@ -210,9 +227,11 @@ def _split_overrides(overrides: Sequence[str]) -> Tuple[List[Tuple[str, str]], L
         key, _, raw = ov.partition("=")
         key = key.strip()
         is_group = False
-        if mode == "set" and "." not in key:
+        # `group=option` and the package-qualified `group@pkg.path=option`
+        group_part = key.split("@", 1)[0]
+        if mode == "set" and "." not in group_part and ("@" in key or "." not in key):
             for root in roots:
-                if (root / key).is_dir():
+                if (root / group_part).is_dir():
                     is_group = True
                     break
         if is_group:
@@ -232,17 +251,21 @@ def compose(
     roots = _search_paths(extra_search_paths)
     group_sel, value_ovs = _split_overrides(overrides)
 
-    # Group selections (e.g. exp=ppo, env=atari) are applied by rewriting the
-    # root defaults list: compose root, then merge each selected group config.
-    cfg = _compose_file(config_name, roots)
-    for group, option in group_sel:
-        sub = _compose_file(f"{group}/{option}", roots)
-        # exp files compose at the root package (hydra @package _global_);
-        # other groups land under their group key.
-        if group == "exp":
-            cfg.merge(sub)
-        else:
-            cfg[group] = sub
+    # Group selections (e.g. env=atari) supersede the matching defaults-list
+    # entries wherever they appear (root or exp); the exp file composes at the
+    # root package afterwards. Selections for groups no defaults entry names
+    # are applied directly under their group key.
+    choices = {g: o for g, o in group_sel if g != "exp"}
+    exp_choice = dict(group_sel).get("exp")
+    used: set = set()
+    cfg = _compose_file(config_name, roots, choices, used)
+    if exp_choice:
+        cfg.merge(_compose_file(f"exp/{exp_choice}", roots, choices, used))
+    for group, option in choices.items():
+        if group not in used:
+            plain, _, dest = group.partition("@")
+            sub = _compose_file(f"{plain}/{option}", roots, choices, used)
+            cfg.set_path(dest if dest else plain, sub)
     for key, value, mode in value_ovs:
         if mode == "del":
             parent = cfg.select(key.rsplit(".", 1)[0]) if "." in key else cfg

@@ -349,12 +349,19 @@ class EnvIndependentReplayBuffer:
     def __len__(self) -> int:
         return self._buffer_size
 
-    def add(self, data: Dict[str, np.ndarray], indices: Optional[Sequence[int]] = None) -> None:
+    def add(
+        self,
+        data: Dict[str, np.ndarray],
+        indices: Optional[Sequence[int]] = None,
+        validate_args: bool = False,
+    ) -> None:
         if indices is None:
             indices = range(self._n_envs)
         indices = list(indices)
         for slot, env_idx in enumerate(indices):
-            self._buffers[env_idx].add({k: v[:, slot : slot + 1] for k, v in data.items()})
+            self._buffers[env_idx].add(
+                {k: v[:, slot : slot + 1] for k, v in data.items()}, validate_args=validate_args
+            )
 
     def sample(
         self, batch_size: int, n_samples: int = 1, **kwargs: Any

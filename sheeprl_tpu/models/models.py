@@ -94,14 +94,16 @@ class MLP(nn.Module):
     flatten_dim: Optional[int] = None
     bias: bool = True
     dtype: Dtype = jnp.float32
+    kernel_init: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
         if self.flatten_dim is not None:
             x = jnp.reshape(x, x.shape[: self.flatten_dim] + (-1,))
         act = get_activation(self.activation)
+        dense_kw = {} if self.kernel_init is None else {"kernel_init": self.kernel_init}
         for i, h in enumerate(self.hidden_sizes):
-            x = nn.Dense(h, use_bias=self.bias, dtype=self.dtype, name=f"dense_{i}")(x)
+            x = nn.Dense(h, use_bias=self.bias, dtype=self.dtype, name=f"dense_{i}", **dense_kw)(x)
             if self.dropout > 0:
                 x = nn.Dropout(self.dropout, deterministic=deterministic)(x)
             norm_args = (self.norm_args[i] if self.norm_args else {}) if self.norm_layer else {}
@@ -110,7 +112,7 @@ class MLP(nn.Module):
                 x = norm(x)
             x = act(x)
         if self.output_dim is not None:
-            x = nn.Dense(self.output_dim, use_bias=self.bias, dtype=self.dtype, name="out")(x)
+            x = nn.Dense(self.output_dim, use_bias=self.bias, dtype=self.dtype, name="out", **dense_kw)(x)
         return x
 
 
