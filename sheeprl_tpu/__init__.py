@@ -16,6 +16,6 @@ import os
 __version__ = "0.1.0"
 
 # Algorithm modules register themselves on import.
-from sheeprl_tpu.algos import a2c, dreamer_v3, droq, ppo, sac, sac_ae  # noqa: F401,E402
+from sheeprl_tpu.algos import a2c, dreamer_v2, dreamer_v3, droq, ppo, sac, sac_ae  # noqa: F401,E402
 
 __all__ = ["__version__"]

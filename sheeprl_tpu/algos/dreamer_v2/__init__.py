@@ -1,0 +1,1 @@
+from . import dreamer_v2  # noqa: F401

@@ -1,0 +1,63 @@
+"""DreamerV2 losses (reference sheeprl/algos/dreamer_v2/loss.py).
+
+KL balancing (Eq. 2 of arXiv:2010.02193): α·KL(sg(post)‖prior) +
+(1-α)·KL(post‖sg(prior)), each side clipped at `kl_free_nats` either after
+averaging (`kl_free_avg=True`) or element-wise. Everything in f32.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...distributions import (
+    Distribution,
+    Independent,
+    OneHotCategoricalStraightThrough,
+    kl_divergence,
+)
+
+
+def reconstruction_loss(
+    po: Dict[str, Distribution],
+    observations: Dict[str, jax.Array],
+    pr: Distribution,
+    rewards: jax.Array,
+    priors_logits: jax.Array,  # [T, B, S, D]
+    posteriors_logits: jax.Array,  # [T, B, S, D]
+    kl_balancing_alpha: float = 0.8,
+    kl_free_nats: float = 0.0,
+    kl_free_avg: bool = True,
+    kl_regularizer: float = 1.0,
+    pc: Optional[Distribution] = None,
+    continue_targets: Optional[jax.Array] = None,
+    discount_scale_factor: float = 1.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Returns (reconstruction_loss, kl, kl_loss, reward_loss,
+    observation_loss, continue_loss) — reference loss.py:9-120."""
+    observation_loss = -sum(po[k].log_prob(observations[k]).mean() for k in po)
+    reward_loss = -pr.log_prob(rewards).mean()
+    sg = jax.lax.stop_gradient
+    lhs = kl = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=sg(posteriors_logits)), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=priors_logits), 1),
+    )
+    rhs = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=posteriors_logits), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=sg(priors_logits)), 1),
+    )
+    free_nats = jnp.asarray(kl_free_nats, jnp.float32)
+    if kl_free_avg:
+        loss_lhs = jnp.maximum(lhs.mean(), free_nats)
+        loss_rhs = jnp.maximum(rhs.mean(), free_nats)
+    else:
+        loss_lhs = jnp.maximum(lhs, free_nats).mean()
+        loss_rhs = jnp.maximum(rhs, free_nats).mean()
+    kl_loss = kl_balancing_alpha * loss_lhs + (1 - kl_balancing_alpha) * loss_rhs
+    if pc is not None and continue_targets is not None:
+        continue_loss = discount_scale_factor * -pc.log_prob(continue_targets).mean()
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    rec_loss = kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss
+    return rec_loss, kl, kl_loss, reward_loss, observation_loss, continue_loss

@@ -1,0 +1,117 @@
+"""DreamerV2 per-algo contract (reference sheeprl/algos/dreamer_v2/utils.py).
+
+`compute_lambda_values` keeps the reference's bootstrap-carrying recursion
+(:85-102) but as a reverse `lax.scan`; `compute_stochastic_state` is the
+discrete one-hot-ST sampler shared with P2E-DV2 (reference :44-61).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...distributions import Independent, OneHotCategoricalStraightThrough
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic"}
+
+
+def compute_stochastic_state(
+    logits: jax.Array, discrete: int, key: Optional[jax.Array] = None, sample: bool = True
+) -> jax.Array:
+    """One-hot straight-through sample of the [*, S, D] categorical state
+    (reference dreamer_v2/utils.py:44-61). Returns [*, S, D]."""
+    logits = logits.reshape(*logits.shape[:-1], -1, discrete)
+    dist = Independent(OneHotCategoricalStraightThrough(logits=logits), 1)
+    if sample:
+        assert key is not None
+        return dist.rsample(key)
+    return dist.base.mode
+
+
+def compute_lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    bootstrap: Optional[jax.Array] = None,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """TD(λ) targets with an explicit bootstrap value (reference
+    dreamer_v2/utils.py:85-102). All inputs [H, B, 1]; returns [H, B, 1]."""
+    if bootstrap is None:
+        bootstrap = jnp.zeros_like(values[-1])
+    next_values = jnp.concatenate([values[1:], bootstrap[None]], axis=0)
+    inputs = rewards + continues * next_values * (1 - lmbda)
+
+    def step(agg, xs):
+        inp, cont = xs
+        agg = inp + cont * lmbda * agg
+        return agg, agg
+
+    _, lvs = jax.lax.scan(step, bootstrap, (inputs, continues), reverse=True)
+    return lvs
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], cnn_keys=(), mlp_keys=(), num_envs: int = 1
+) -> Dict[str, jax.Array]:
+    """Host obs → device; images normalized to [-0.5, 0.5] in the train/player
+    path (reference dreamer_v2/utils.py:105-115 does /255 - 0.5 here; we keep
+    uint8 on host and normalize on device in `normalize_obs`)."""
+    out: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        out[k] = jnp.asarray(np.asarray(obs[k]).reshape(num_envs, *np.asarray(obs[k]).shape[-3:]))
+    for k in mlp_keys:
+        out[k] = jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1))
+    return out
+
+
+def normalize_obs(obs: Dict[str, jax.Array], cnn_keys) -> Dict[str, jax.Array]:
+    return {k: (v.astype(jnp.float32) / 255.0 - 0.5) if k in cnn_keys else v for k, v in obs.items()}
+
+
+def test(player_step, player_state, env, cfg, log_dir: str, logger=None, seed=None) -> float:
+    """Greedy episode with the device-resident player (reference utils.py test)."""
+    import gymnasium as gym
+
+    done = False
+    cumulative_rew = 0.0
+    obs, _ = env.reset(seed=seed if seed is not None else cfg.seed)
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    key = jax.random.key(cfg.seed)
+    is_box = isinstance(env.action_space, gym.spaces.Box)
+    while not done:
+        device_obs = prepare_obs(obs, cnn_keys, mlp_keys, 1)
+        key, k = jax.random.split(key)
+        env_actions, player_state = player_step(device_obs, player_state, k, True)
+        acts = np.asarray(env_actions)
+        if is_box or isinstance(env.action_space, gym.spaces.MultiDiscrete):
+            step_action = acts.reshape(env.action_space.shape)
+        else:
+            step_action = acts.reshape(()).item()
+        obs, reward, terminated, truncated, _ = env.step(step_action)
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.get("dry_run", False):
+            done = True
+    if logger is not None:
+        logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    print(f"Test - Reward: {cumulative_rew}")
+    env.close()
+    return cumulative_rew

@@ -324,6 +324,36 @@ class TruncatedNormal(TruncatedStandardNormal):
         return self._from_std(super().icdf(value))
 
 
+class TanhNormal(Distribution):
+    """tanh-squashed Normal (the reference composes
+    `TransformedDistribution(Normal, TanhTransform)` — dreamer_v1/v2
+    agent.py `tanh_normal` branch). Entropy has no closed form; callers
+    catch `NotImplementedError` and substitute zeros, matching torch."""
+
+    def __init__(self, loc: jax.Array, scale: jax.Array):
+        self.base = Normal(loc, scale)
+
+    def sample(self, key, sample_shape=()):
+        return jnp.tanh(self.base.sample(key, sample_shape))
+
+    def rsample(self, key, sample_shape=()):
+        return jnp.tanh(self.base.rsample(key, sample_shape))
+
+    def log_prob(self, value):
+        eps = 1e-6
+        clipped = jnp.clip(value, -1 + eps, 1 - eps)
+        pre_tanh = jnp.arctanh(clipped)
+        return self.base.log_prob(pre_tanh) - jnp.log1p(-jnp.square(clipped))
+
+    @property
+    def mode(self):
+        return jnp.tanh(self.base.loc)
+
+    @property
+    def mean(self):
+        return jnp.tanh(self.base.loc)
+
+
 class SymlogDistribution(Distribution):
     """'Distribution' whose log_prob is -|symlog(x) - mode|^p (reference
     distribution.py:152-193); used by the DV3 vector-obs decoder."""

@@ -73,6 +73,47 @@ def test_dreamer_v3(standard_args, env_id):
     )
 
 
+@pytest.mark.parametrize(
+    "env_id,buffer_type,distribution",
+    [
+        ("discrete_dummy", "sequential", "auto"),
+        ("discrete_dummy", "episode", "auto"),
+        ("multidiscrete_dummy", "sequential", "auto"),
+        ("multidiscrete_dummy", "episode", "auto"),
+        ("continuous_dummy", "sequential", "auto"),
+        ("continuous_dummy", "episode", "auto"),
+        ("continuous_dummy", "sequential", "tanh_normal"),
+    ],
+)
+def test_dreamer_v2(standard_args, env_id, buffer_type, distribution):
+    _run(
+        [
+            "exp=dreamer_v2",
+            "env=dummy",
+            f"env.id={env_id}",
+            f"buffer.type={buffer_type}",
+            f"distribution.type={distribution}",
+            "algo.per_rank_batch_size=2",
+            "algo.per_rank_sequence_length=2",
+            "algo.per_rank_pretrain_steps=1",
+            "algo.learning_starts=0",
+            "algo.horizon=4",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=16",
+            "algo.world_model.transition_model.hidden_size=16",
+            "algo.world_model.representation_model.hidden_size=16",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.stochastic_size=4",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.size=64",
+        ],
+        standard_args,
+    )
+
+
 def test_sac_ae(standard_args):
     _run(
         [
