@@ -16,6 +16,15 @@ import os
 __version__ = "0.1.0"
 
 # Algorithm modules register themselves on import.
-from sheeprl_tpu.algos import a2c, dreamer_v2, dreamer_v3, droq, ppo, sac, sac_ae  # noqa: F401,E402
+from sheeprl_tpu.algos import (  # noqa: F401,E402
+    a2c,
+    dreamer_v1,
+    dreamer_v2,
+    dreamer_v3,
+    droq,
+    ppo,
+    sac,
+    sac_ae,
+)
 
 __all__ = ["__version__"]

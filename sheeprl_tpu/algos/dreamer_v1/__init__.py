@@ -1,0 +1,1 @@
+from . import dreamer_v1  # noqa: F401 — registers the algorithm

@@ -1,0 +1,379 @@
+"""DreamerV1 agent (reference sheeprl/algos/dreamer_v1/agent.py, 547 LoC).
+
+The reference reuses DreamerV2's encoders/decoders/actor and swaps the RSSM
+stochastic state for a diagonal Gaussian (agent.py:16-29 imports DV2
+components; RSSM :64-191). We mirror that: `DV1WorldModel` composes the DV2
+encoder/decoder/head modules around a Gaussian `DV1RSSM`.
+
+Differences from DV2 carried over from the reference:
+* stochastic state ~ Normal(mean, softplus(std)+min_std) (utils.py:81-108);
+* the recurrent model is Dense→act→plain GRU (agent.py:32-61), not the
+  Hafner LayerNorm-GRU;
+* `dynamic` has no `is_first` reset (agent.py:98-135 — episode-boundary
+  masking was introduced in DV2/DV3 only).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models import MLP
+from ..dreamer_v2.agent import (  # reused wholesale, as in the reference
+    DV2Actor,
+    DV2Decoder,
+    DV2Encoder,
+    DV2Head,
+    dv2_actor_dists,
+    dv2_exploration_noise,
+    dv2_sample_actions,
+)
+
+Actor = DV2Actor  # reference aliases DV1 Actor to the DV2 one (agent.py:28-29)
+
+
+def compute_stochastic_state(
+    state_information: jax.Array,
+    key: Optional[jax.Array],
+    min_std: float = 0.1,
+) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array]:
+    """Gaussian state from concatenated (mean, std) (reference
+    dreamer_v1/utils.py:81-108): std = softplus(raw)+min_std, rsample."""
+    mean, std = jnp.split(state_information, 2, axis=-1)
+    std = jax.nn.softplus(std) + min_std
+    if key is None:
+        sample = mean
+    else:
+        sample = mean + std * jax.random.normal(key, mean.shape, dtype=mean.dtype)
+    return (mean, std), sample
+
+
+class DV1RecurrentModel(nn.Module):
+    """Dense→act→GRU (reference agent.py:32-61; a *standard* GRU — the
+    LayerNorm/Hafner variants are DV2+)."""
+
+    recurrent_state_size: int
+    activation: str = "elu"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, h: jax.Array) -> jax.Array:
+        from ...models.models import get_activation
+
+        feat = get_activation(self.activation)(
+            nn.Dense(self.recurrent_state_size, name="fc")(x)
+        )
+        new_h, _ = nn.GRUCell(self.recurrent_state_size, name="gru")(h, feat)
+        return new_h
+
+
+class _DV1StochHead(nn.Module):
+    """One hidden layer + (mean, std) head of width 2*stochastic_size
+    (reference build_agent :426-449)."""
+
+    hidden_size: int
+    stochastic_size: int
+    activation: str = "elu"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = MLP(hidden_sizes=(self.hidden_size,), activation=self.activation)(x)
+        return nn.Dense(2 * self.stochastic_size, name="mean_std")(x)
+
+
+class DV1RSSM(nn.Module):
+    """Gaussian RSSM (reference agent.py:64-191): recurrent step from
+    (posterior, action); prior from the recurrent output; posterior from the
+    recurrent state + embedded obs. All single-step and scan-ready."""
+
+    stochastic_size: int = 30
+    recurrent_state_size: int = 200
+    hidden_size: int = 200
+    representation_hidden_size: Optional[int] = None
+    min_std: float = 0.1
+    dense_act: str = "elu"
+
+    def setup(self) -> None:
+        self.recurrent_model = DV1RecurrentModel(self.recurrent_state_size, self.dense_act)
+        self.representation_model = _DV1StochHead(
+            self.representation_hidden_size or self.hidden_size,
+            self.stochastic_size,
+            self.dense_act,
+            name="representation",
+        )
+        self.transition_model = _DV1StochHead(
+            self.hidden_size, self.stochastic_size, self.dense_act, name="transition"
+        )
+
+    def _transition(self, recurrent_out: jax.Array, key: Optional[jax.Array]):
+        return compute_stochastic_state(
+            self.transition_model(recurrent_out), key, self.min_std
+        )
+
+    def _representation(self, recurrent_state: jax.Array, embedded_obs: jax.Array, key):
+        return compute_stochastic_state(
+            self.representation_model(jnp.concatenate([recurrent_state, embedded_obs], -1)),
+            key,
+            self.min_std,
+        )
+
+    def dynamic(
+        self,
+        posterior: jax.Array,  # [B, S]
+        recurrent_state: jax.Array,  # [B, R]
+        action: jax.Array,  # [B, A]
+        embedded_obs: jax.Array,  # [B, E]
+        key: jax.Array,
+    ):
+        """One dynamic-learning step (reference :98-135). Returns the new
+        recurrent state, sampled posterior, and the (mean, std) pairs of both
+        the posterior and the prior."""
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], -1), recurrent_state
+        )
+        # prior sample is unused in dynamic learning — only its (mean, std)
+        # enter the KL; key=None skips the draw
+        prior_mean_std, _ = self._transition(recurrent_state, None)
+        posterior_mean_std, posterior = self._representation(
+            recurrent_state, embedded_obs, key
+        )
+        return recurrent_state, posterior, posterior_mean_std, prior_mean_std
+
+    def imagination(
+        self, stochastic_state: jax.Array, recurrent_state: jax.Array, action: jax.Array, key
+    ) -> Tuple[jax.Array, jax.Array]:
+        """One-step imagination (reference :169-191): prior sample only."""
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([stochastic_state, action], -1), recurrent_state
+        )
+        _, imagined_prior = self._transition(recurrent_state, key)
+        return imagined_prior, recurrent_state
+
+    def representation_step(self, recurrent_state: jax.Array, embedded_obs: jax.Array, key):
+        _, posterior = self._representation(recurrent_state, embedded_obs, key)
+        return posterior
+
+    def __call__(self, posterior, recurrent_state, action, embedded_obs, key):
+        return self.dynamic(posterior, recurrent_state, action, embedded_obs, key)
+
+
+class DV1WorldModel(nn.Module):
+    """Encoder + Gaussian RSSM + decoder + reward [+ continue] (reference
+    agent.py:192-217 `WorldModel`; module sizes from build_agent :301-500)."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_output_channels: Sequence[int]
+    mlp_output_dims: Sequence[int]
+    cnn_channels_multiplier: int
+    mlp_layers: int
+    dense_units: int
+    stochastic_size: int
+    recurrent_state_size: int
+    hidden_size: int
+    min_std: float = 0.1
+    cnn_act: str = "relu"
+    dense_act: str = "elu"
+    use_continues: bool = False
+    representation_hidden_size: Optional[int] = None
+    decoder_cnn_channels_multiplier: Optional[int] = None
+    encoder_mlp_layers: Optional[int] = None
+    encoder_dense_units: Optional[int] = None
+    decoder_mlp_layers: Optional[int] = None
+    decoder_dense_units: Optional[int] = None
+    reward_mlp_layers: Optional[int] = None
+    reward_dense_units: Optional[int] = None
+    continue_mlp_layers: Optional[int] = None
+    continue_dense_units: Optional[int] = None
+
+    def setup(self) -> None:
+        self.encoder = DV2Encoder(
+            cnn_keys=self.cnn_keys,
+            mlp_keys=self.mlp_keys,
+            cnn_channels_multiplier=self.cnn_channels_multiplier,
+            mlp_layers=self.encoder_mlp_layers or self.mlp_layers,
+            dense_units=self.encoder_dense_units or self.dense_units,
+            layer_norm=False,
+            cnn_act=self.cnn_act,
+            dense_act=self.dense_act,
+        )
+        self.rssm = DV1RSSM(
+            stochastic_size=self.stochastic_size,
+            recurrent_state_size=self.recurrent_state_size,
+            hidden_size=self.hidden_size,
+            representation_hidden_size=self.representation_hidden_size,
+            min_std=self.min_std,
+            dense_act=self.dense_act,
+        )
+        cnn_encoder_output_dim = 8 * self.cnn_channels_multiplier * 2 * 2
+        self.observation_model = DV2Decoder(
+            cnn_keys=self.cnn_keys,
+            mlp_keys=self.mlp_keys,
+            cnn_output_channels=self.cnn_output_channels,
+            mlp_output_dims=self.mlp_output_dims,
+            cnn_channels_multiplier=self.decoder_cnn_channels_multiplier
+            or self.cnn_channels_multiplier,
+            cnn_encoder_output_dim=cnn_encoder_output_dim,
+            mlp_layers=self.decoder_mlp_layers or self.mlp_layers,
+            dense_units=self.decoder_dense_units or self.dense_units,
+            layer_norm=False,
+            cnn_act=self.cnn_act,
+            dense_act=self.dense_act,
+        )
+        self.reward_model = DV2Head(
+            1,
+            self.reward_mlp_layers or self.mlp_layers,
+            self.reward_dense_units or self.dense_units,
+            False,
+            self.dense_act,
+            name="reward",
+        )
+        if self.use_continues:
+            self.continue_model = DV2Head(
+                1,
+                self.continue_mlp_layers or self.mlp_layers,
+                self.continue_dense_units or self.dense_units,
+                False,
+                self.dense_act,
+                name="continue",
+            )
+
+    def embed(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        return self.encoder(obs)
+
+    def dynamic(self, posterior, recurrent_state, action, embedded_obs, key):
+        return self.rssm.dynamic(posterior, recurrent_state, action, embedded_obs, key)
+
+    def imagination(self, stochastic_state, recurrent_state, action, key):
+        return self.rssm.imagination(stochastic_state, recurrent_state, action, key)
+
+    def recurrent_step(self, stoch_and_action: jax.Array, recurrent_state: jax.Array) -> jax.Array:
+        return self.rssm.recurrent_model(stoch_and_action, recurrent_state)
+
+    def representation_step(self, recurrent_state, embedded_obs, key):
+        return self.rssm.representation_step(recurrent_state, embedded_obs, key)
+
+    def decode(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        return self.observation_model(latent)
+
+    def reward(self, latent: jax.Array) -> jax.Array:
+        return self.reward_model(latent)
+
+    def cont(self, latent: jax.Array) -> jax.Array:
+        if not self.use_continues:
+            raise RuntimeError("continue model disabled (algo.world_model.use_continues=False)")
+        return self.continue_model(latent)
+
+    def __call__(self, obs, posterior, recurrent_state, action, key):
+        embedded = self.encoder(obs)
+        h, post, post_ms, prior_ms = self.rssm.dynamic(
+            posterior, recurrent_state, action, embedded, key
+        )
+        latent = jnp.concatenate([post, h], -1)
+        outs = (self.observation_model(latent), self.reward_model(latent), post_ms, prior_ms)
+        if self.use_continues:
+            outs = outs + (self.continue_model(latent),)
+        return outs
+
+
+def build_agent(
+    dist: Any,
+    cfg: Any,
+    observation_space: gym.spaces.Dict,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    key: jax.Array,
+    state: Optional[Dict[str, Any]] = None,
+):
+    """Construct (world_model, actor, critic, params) — reference build_agent
+    (agent.py:301-547). params = {wm, actor, critic} (no target critic in
+    DV1)."""
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    wm_cfg = cfg.algo.world_model
+    world_model = DV1WorldModel(
+        cnn_keys=cnn_keys,
+        mlp_keys=mlp_keys,
+        cnn_output_channels=[observation_space[k].shape[-1] for k in cnn_keys],
+        mlp_output_dims=[int(np.prod(observation_space[k].shape)) for k in mlp_keys],
+        cnn_channels_multiplier=int(wm_cfg.encoder.cnn_channels_multiplier),
+        mlp_layers=int(cfg.algo.mlp_layers),
+        dense_units=int(cfg.algo.dense_units),
+        stochastic_size=int(wm_cfg.stochastic_size),
+        recurrent_state_size=int(wm_cfg.recurrent_model.recurrent_state_size),
+        hidden_size=int(wm_cfg.transition_model.hidden_size),
+        min_std=float(wm_cfg.min_std),
+        cnn_act=str(cfg.algo.cnn_act),
+        dense_act=str(cfg.algo.dense_act),
+        use_continues=bool(wm_cfg.use_continues),
+        representation_hidden_size=int(wm_cfg.representation_model.hidden_size),
+        decoder_cnn_channels_multiplier=int(wm_cfg.observation_model.cnn_channels_multiplier),
+        encoder_mlp_layers=int(wm_cfg.encoder.mlp_layers),
+        encoder_dense_units=int(wm_cfg.encoder.dense_units),
+        decoder_mlp_layers=int(wm_cfg.observation_model.mlp_layers),
+        decoder_dense_units=int(wm_cfg.observation_model.dense_units),
+        reward_mlp_layers=int(wm_cfg.reward_model.mlp_layers),
+        reward_dense_units=int(wm_cfg.reward_model.dense_units),
+        continue_mlp_layers=int(wm_cfg.discount_model.mlp_layers),
+        continue_dense_units=int(wm_cfg.discount_model.dense_units),
+    )
+    latent_size = int(wm_cfg.stochastic_size) + int(wm_cfg.recurrent_model.recurrent_state_size)
+    actor = DV2Actor(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        distribution=str(cfg.distribution.type if cfg.select("distribution.type") else "auto"),
+        init_std=float(cfg.algo.actor.init_std),
+        min_std=float(cfg.algo.actor.min_std),
+        mlp_layers=int(cfg.algo.actor.mlp_layers),
+        dense_units=int(cfg.algo.actor.dense_units),
+        layer_norm=False,
+        activation=str(
+            cfg.algo.actor.dense_act if cfg.select("algo.actor.dense_act") else cfg.algo.dense_act
+        ),
+    )
+    critic = DV2Head(
+        1,
+        int(cfg.algo.critic.mlp_layers),
+        int(cfg.algo.critic.dense_units),
+        False,
+        str(cfg.algo.critic.dense_act if cfg.select("algo.critic.dense_act") else cfg.algo.dense_act),
+    )
+    if state is not None:
+        params = state
+    else:
+        kw, ka, kc, ks = jax.random.split(key, 4)
+        B = 1
+        dummy_obs = {}
+        for k in cnn_keys:
+            dummy_obs[k] = jnp.zeros((B,) + tuple(observation_space[k].shape), jnp.float32)
+        for k in mlp_keys:
+            dummy_obs[k] = jnp.zeros((B, int(np.prod(observation_space[k].shape))), jnp.float32)
+        wm_params = world_model.init(
+            {"params": kw},
+            dummy_obs,
+            jnp.zeros((B, int(wm_cfg.stochastic_size))),
+            jnp.zeros((B, int(wm_cfg.recurrent_model.recurrent_state_size))),
+            jnp.zeros((B, int(sum(actions_dim)))),
+            ks,
+        )["params"]
+        actor_params = actor.init(ka, jnp.zeros((B, latent_size)))["params"]
+        critic_params = critic.init(kc, jnp.zeros((B, latent_size)))["params"]
+        params = {"wm": wm_params, "actor": actor_params, "critic": critic_params}
+    params = dist.replicate(params)
+    return world_model, actor, critic, params
+
+
+__all__ = [
+    "Actor",
+    "DV1RSSM",
+    "DV1RecurrentModel",
+    "DV1WorldModel",
+    "build_agent",
+    "compute_stochastic_state",
+    "dv2_actor_dists",
+    "dv2_exploration_noise",
+    "dv2_sample_actions",
+]

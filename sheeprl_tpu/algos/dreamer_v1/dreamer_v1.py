@@ -1,18 +1,17 @@
-"""DreamerV2 — discrete world-model RL (Template B).
+"""DreamerV1 — Gaussian world-model RL (Template B).
 
-Reference sheeprl/algos/dreamer_v2/dreamer_v2.py (792 LoC). TPU-native
-re-design mirroring the DreamerV3 implementation in this repo:
+Reference sheeprl/algos/dreamer_v1/dreamer_v1.py (750 LoC). TPU-native
+re-design mirroring this repo's DreamerV2/V3 implementations:
 
-* dynamic learning (reference python loop :146-160) → `lax.scan` of the
-  fused RSSM cell; imagination (:258-276) → second scan;
-* one jitted, donated-argument gradient step covering world model, actor
-  (objective_mix reinforce/dynamics), critic and the hard target-critic
-  copy (reference :695-701 copies every
-  `critic.per_rank_target_network_update_freq` steps);
-* Normal(·,1) observation/reward/value heads, KL balancing with free nats
-  (loss.py), optional continue model (`use_continues`);
-* `buffer.type ∈ {sequential, episode}` selects the replay backend
-  (reference :496-517).
+* dynamic learning (reference python loop :144-157) → `lax.scan` of the
+  Gaussian RSSM step; imagination (:240-250) → second scan;
+* one jitted, donated-argument gradient step updating world model, actor
+  (pure dynamics-backprop: loss = -E[discount·λ], no reinforce mix) and
+  critic — DV1 has no target critic;
+* Normal(·,1) observation/reward/value heads; Gaussian KL with free nats
+  (no balancing);
+* exploration-noise player with the `expl_amount` half-life decay schedule
+  (reference dreamer_v2/agent.py:499-503, shared by DV1).
 """
 from __future__ import annotations
 
@@ -27,7 +26,7 @@ import numpy as np
 import optax
 
 from ...config import Config, instantiate
-from ...data import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from ...distributions import Bernoulli, Independent, Normal
 from ...optim import clipped
 from ...parallel import Distributed
@@ -38,15 +37,9 @@ from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...utils.timer import timer
 from ...utils.utils import Ratio, save_configs
-from .agent import (
-    DV2Actor,
-    DV2WorldModel,
-    build_agent,
-    dv2_actor_dists,
-    dv2_exploration_noise,
-    dv2_sample_actions,
-)
-from .loss import reconstruction_loss
+from ..dreamer_v2.dreamer_v2 import make_player as make_dreamer_player
+from .agent import DV1WorldModel, build_agent, dv2_sample_actions
+from .loss import actor_loss, critic_loss, reconstruction_loss
 from .utils import (
     AGGREGATOR_KEYS,
     compute_lambda_values,
@@ -57,8 +50,8 @@ from .utils import (
 
 
 def make_train_fn(
-    wm: DV2WorldModel,
-    actor: DV2Actor,
+    wm: DV1WorldModel,
+    actor,
     critic,
     txs,
     cfg: Config,
@@ -68,15 +61,12 @@ def make_train_fn(
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     wm_cfg = cfg.algo.world_model
-    stoch_flat = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
+    S = int(wm_cfg.stochastic_size)
     R = int(wm_cfg.recurrent_model.recurrent_state_size)
     horizon = int(cfg.algo.horizon)
     gamma = float(cfg.algo.gamma)
     lmbda = float(cfg.algo.lmbda)
-    ent_coef = float(cfg.algo.actor.ent_coef)
-    objective_mix = float(cfg.algo.actor.objective_mix)
     use_continues = bool(wm_cfg.use_continues)
-    target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
 
     def wm_apply(p, method, *args):
         return wm.apply({"params": p}, *args, method=method)
@@ -85,73 +75,67 @@ def make_train_fn(
         T, B = batch["rewards"].shape[:2]
         k_dyn, k_img = jax.random.split(key, 2)
         batch_obs = normalize_obs({k: batch[k] for k in cnn_keys + mlp_keys}, cnn_keys)
-        is_first = batch["is_first"].at[0].set(1.0)
-
-        # hard target-critic copy every `target_freq` steps, evaluated
-        # *before* the gradient step (reference :695-701)
-        step = opt_states["step"]
-        do_t = (step % target_freq) == 0
-        params["target_critic"] = jax.tree.map(
-            lambda t, s: jnp.where(do_t, s, t), params["target_critic"], params["critic"]
-        )
 
         # ---------------- world model ------------------------------------
         def wm_loss_fn(wm_params):
-            embedded = wm_apply(wm_params, DV2WorldModel.embed, batch_obs)  # [T, B, E]
+            embedded = wm_apply(wm_params, DV1WorldModel.embed, batch_obs)  # [T, B, E]
 
             def dyn_step(carry, xs):
                 h, z = carry
-                a, e, first, k = xs
-                h, z, post_logits, prior_logits = wm.apply(
-                    {"params": wm_params}, z, h, a, e, first, k, method=DV2WorldModel.dynamic
+                a, e, k = xs
+                h, z, post_ms, prior_ms = wm.apply(
+                    {"params": wm_params}, z, h, a, e, k, method=DV1WorldModel.dynamic
                 )
-                return (h, z), (h, z, post_logits, prior_logits)
+                return (h, z), (h, z, post_ms[0], post_ms[1], prior_ms[0], prior_ms[1])
 
             keys = jax.random.split(k_dyn, T)
-            h0 = jnp.zeros((B, R))
-            z0 = jnp.zeros((B, stoch_flat))
-            _, (hs, zs, post_logits, prior_logits) = jax.lax.scan(
-                dyn_step, (h0, z0), (batch["actions"], embedded, is_first, keys)
+            _, (hs, zs, post_mean, post_std, prior_mean, prior_std) = jax.lax.scan(
+                dyn_step,
+                (jnp.zeros((B, R)), jnp.zeros((B, S))),
+                (batch["actions"], embedded, keys),
             )
             latents = jnp.concatenate([zs, hs], axis=-1)
-            recon = wm_apply(wm_params, DV2WorldModel.decode, latents)
-            po = {
+            recon = wm_apply(wm_params, DV1WorldModel.decode, latents)
+            qo = {
                 k: Independent(Normal(recon[k], 1.0), 3 if k in cnn_keys else 1)
                 for k in cnn_keys + mlp_keys
             }
-            pr = Independent(Normal(wm_apply(wm_params, DV2WorldModel.reward, latents), 1.0), 1)
+            qr = Independent(Normal(wm_apply(wm_params, DV1WorldModel.reward, latents), 1.0), 1)
             if use_continues:
-                pc = Independent(Bernoulli(logits=wm_apply(wm_params, DV2WorldModel.cont, latents)), 1)
+                qc = Independent(
+                    Bernoulli(logits=wm_apply(wm_params, DV1WorldModel.cont, latents)), 1
+                )
                 continues_targets = (1 - batch["terminated"]) * gamma
             else:
-                pc = continues_targets = None
-            S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
-            rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
-                po,
-                batch_obs,
-                pr,
-                batch["rewards"],
-                prior_logits.reshape(T, B, S, D),
-                post_logits.reshape(T, B, S, D),
-                float(wm_cfg.kl_balancing_alpha),
-                float(wm_cfg.kl_free_nats),
-                bool(wm_cfg.kl_free_avg),
-                float(wm_cfg.kl_regularizer),
-                pc,
-                continues_targets,
-                float(wm_cfg.discount_scale_factor),
+                qc = continues_targets = None
+            posteriors_dist = Independent(Normal(post_mean, post_std), 1)
+            priors_dist = Independent(Normal(prior_mean, prior_std), 1)
+            rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = (
+                reconstruction_loss(
+                    qo,
+                    batch_obs,
+                    qr,
+                    batch["rewards"],
+                    posteriors_dist,
+                    priors_dist,
+                    float(wm_cfg.kl_free_nats),
+                    float(wm_cfg.kl_regularizer),
+                    qc,
+                    continues_targets,
+                    float(wm_cfg.continue_scale_factor),
+                )
             )
             aux = {
                 "zs": zs,
                 "hs": hs,
-                "post_logits": post_logits,
-                "prior_logits": prior_logits,
+                "post_entropy": jnp.mean(posteriors_dist.entropy()),
+                "prior_entropy": jnp.mean(priors_dist.entropy()),
                 "Loss/world_model_loss": rec_loss,
                 "Loss/observation_loss": observation_loss,
                 "Loss/reward_loss": reward_loss,
                 "Loss/state_loss": state_loss,
                 "Loss/continue_loss": continue_loss,
-                "State/kl": jnp.mean(kl),
+                "State/kl": kl,
             }
             return rec_loss, aux
 
@@ -159,74 +143,54 @@ def make_train_fn(
         updates, opt_states["wm"] = txs["wm"].update(wm_grads, opt_states["wm"], params["wm"])
         params["wm"] = optax.apply_updates(params["wm"], updates)
 
-        # ---------------- behaviour --------------------------------------
-        imagined_prior0 = jax.lax.stop_gradient(wm_aux["zs"]).reshape(T * B, stoch_flat)
+        # ---------------- behaviour (dynamics backprop) -------------------
+        imagined_prior0 = jax.lax.stop_gradient(wm_aux["zs"]).reshape(T * B, S)
         recurrent0 = jax.lax.stop_gradient(wm_aux["hs"]).reshape(T * B, R)
-        latent0 = jnp.concatenate([imagined_prior0, recurrent0], axis=-1)
-        act_width = int(sum(actions_dim))
 
         def rollout(actor_params, key):
-            """Imagination rollout (reference :258-276): trajectories[0] is the
-            posterior latent, action[0] is zeros; H further imagined steps."""
+            """Imagination (reference :228-250): act on the current latent,
+            step the prior, store the *post-step* latent — H rows total."""
 
             def img_step(carry, k):
-                z, h, latent = carry
+                z, h = carry
                 k_a, k_i = jax.random.split(k)
+                latent = jnp.concatenate([z, h], axis=-1)
                 pre = actor.apply({"params": actor_params}, jax.lax.stop_gradient(latent))
                 acts, _ = dv2_sample_actions(actor, pre, k_a)
                 a = jnp.concatenate(acts, axis=-1)
                 z, h = wm.apply(
-                    {"params": params["wm"]}, z, h, a, k_i, method=DV2WorldModel.imagination
+                    {"params": params["wm"]}, z, h, a, k_i, method=DV1WorldModel.imagination
                 )
-                latent = jnp.concatenate([z, h], axis=-1)
-                return (z, h, latent), (latent, a)
+                return (z, h), jnp.concatenate([z, h], axis=-1)
 
             keys = jax.random.split(key, horizon)
-            _, (latents, actions) = jax.lax.scan(
-                img_step, (imagined_prior0, recurrent0, latent0), keys
-            )
-            trajectories = jnp.concatenate([latent0[None], latents], axis=0)  # [H+1, TB, L]
-            imagined_actions = jnp.concatenate(
-                [jnp.zeros((1, T * B, act_width)), actions], axis=0
-            )
-            return trajectories, imagined_actions
+            _, latents = jax.lax.scan(img_step, (imagined_prior0, recurrent0), keys)
+            return latents  # [H, T*B, S+R]
 
         def actor_loss_fn(actor_params):
-            trajectories, imagined_actions = rollout(actor_params, k_img)
-            target_values = critic.apply({"params": params["target_critic"]}, trajectories)
-            rewards_img = wm_apply(params["wm"], DV2WorldModel.reward, trajectories)
+            trajectories = rollout(actor_params, k_img)
+            predicted_values = critic.apply({"params": params["critic"]}, trajectories)
+            predicted_rewards = wm_apply(params["wm"], DV1WorldModel.reward, trajectories)
             if use_continues:
-                continues = nnprobs(wm_apply(params["wm"], DV2WorldModel.cont, trajectories))
-                true_cont = (1 - batch["terminated"]).reshape(1, T * B, 1) * gamma
-                continues = jnp.concatenate([true_cont, continues[1:]], axis=0)
+                continues = jax.nn.sigmoid(
+                    wm_apply(params["wm"], DV1WorldModel.cont, trajectories)
+                )
             else:
-                continues = jnp.ones_like(rewards_img) * gamma
+                continues = jnp.ones_like(predicted_rewards) * gamma
             lv = compute_lambda_values(
-                rewards_img[:-1], target_values[:-1], continues[:-1],
-                bootstrap=target_values[-1], lmbda=lmbda,
+                predicted_rewards,
+                predicted_values,
+                continues,
+                last_values=predicted_values[-1],
+                horizon=horizon,
+                lmbda=lmbda,
             )
             discount = jax.lax.stop_gradient(
-                jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-1]], 0), 0)
+                jnp.cumprod(
+                    jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-2]], axis=0), axis=0
+                )
             )
-            pre_dist = actor.apply(
-                {"params": actor_params}, jax.lax.stop_gradient(trajectories[:-2])
-            )
-            dists = dv2_actor_dists(actor, pre_dist)
-            dynamics = lv[1:]
-            advantage = jax.lax.stop_gradient(lv[1:] - target_values[:-2])
-            logprobs = []
-            start = 0
-            for d, adim in zip(dists, actions_dim):
-                act = jax.lax.stop_gradient(imagined_actions[1:-1, ..., start : start + adim])
-                logprobs.append(d.log_prob(act)[..., None])
-                start += adim
-            reinforce = sum(logprobs) * advantage
-            objective = objective_mix * reinforce + (1 - objective_mix) * dynamics
-            try:
-                entropy = ent_coef * sum(d.entropy() for d in dists)[..., None]
-            except NotImplementedError:
-                entropy = jnp.zeros_like(objective)
-            policy_loss = -jnp.mean(discount[:-2] * (objective + entropy))
+            policy_loss = actor_loss(discount * lv)
             aux = {
                 "trajectories": jax.lax.stop_gradient(trajectories),
                 "lambda_values": jax.lax.stop_gradient(lv),
@@ -237,32 +201,24 @@ def make_train_fn(
         (policy_loss, a_aux), a_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
             params["actor"]
         )
-        updates, opt_states["actor"] = txs["actor"].update(a_grads, opt_states["actor"], params["actor"])
+        updates, opt_states["actor"] = txs["actor"].update(
+            a_grads, opt_states["actor"], params["actor"]
+        )
         params["actor"] = optax.apply_updates(params["actor"], updates)
 
         # ---------------- critic ------------------------------------------
-        traj_sg = a_aux["trajectories"]
-        lv_sg = a_aux["lambda_values"]
-        discount = a_aux["discount"]
-
         def critic_loss_fn(critic_params):
-            qv = Independent(Normal(critic.apply({"params": critic_params}, traj_sg[:-1]), 1.0), 1)
-            return -jnp.mean(discount[:-1, ..., 0] * qv.log_prob(lv_sg))
+            qv = Independent(
+                Normal(critic.apply({"params": critic_params}, a_aux["trajectories"][:-1]), 1.0), 1
+            )
+            return critic_loss(qv, a_aux["lambda_values"], a_aux["discount"][..., 0])
 
         value_loss, c_grads = jax.value_and_grad(critic_loss_fn)(params["critic"])
-        updates, opt_states["critic"] = txs["critic"].update(c_grads, opt_states["critic"], params["critic"])
+        updates, opt_states["critic"] = txs["critic"].update(
+            c_grads, opt_states["critic"], params["critic"]
+        )
         params["critic"] = optax.apply_updates(params["critic"], updates)
-        opt_states["step"] = step + 1
 
-        S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
-        from ...distributions import OneHotCategoricalStraightThrough
-
-        post_ent = Independent(
-            OneHotCategoricalStraightThrough(logits=wm_aux["post_logits"].reshape(T, B, S, D)), 1
-        ).entropy()
-        prior_ent = Independent(
-            OneHotCategoricalStraightThrough(logits=wm_aux["prior_logits"].reshape(T, B, S, D)), 1
-        ).entropy()
         metrics = {
             "Loss/world_model_loss": wm_aux["Loss/world_model_loss"],
             "Loss/observation_loss": wm_aux["Loss/observation_loss"],
@@ -270,15 +226,12 @@ def make_train_fn(
             "Loss/state_loss": wm_aux["Loss/state_loss"],
             "Loss/continue_loss": wm_aux["Loss/continue_loss"],
             "State/kl": wm_aux["State/kl"],
-            "State/post_entropy": jnp.mean(post_ent),
-            "State/prior_entropy": jnp.mean(prior_ent),
+            "State/post_entropy": wm_aux["post_entropy"],
+            "State/prior_entropy": wm_aux["prior_entropy"],
             "Loss/policy_loss": policy_loss,
             "Loss/value_loss": value_loss,
         }
         return params, opt_states, metrics
-
-    def nnprobs(logits):
-        return jax.nn.sigmoid(logits)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def train(params, opt_states, batch, key):
@@ -288,116 +241,23 @@ def make_train_fn(
 
 
 def make_player(
-    wm,
-    actor,
-    cfg: Config,
-    actions_dim,
-    is_continuous: bool,
-    num_envs: int,
-    stoch_width: int = None,
+    wm: DV1WorldModel, actor, cfg: Config, actions_dim, is_continuous: bool, num_envs: int
 ):
-    """Device-resident player (replaces reference PlayerDV2, agent.py:735-833):
-    zero-initialised (h, z, a) carried on device between env steps.
-
-    Shared with DreamerV1 (reference PlayerDV1, dreamer_v1/agent.py:219-298,
-    identical apart from the stochastic-state width): pass `stoch_width` for
-    non-discrete world models; world-model methods are resolved by name so any
-    module exposing embed/recurrent_step/representation_step works."""
-    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
-    wm_cfg = cfg.algo.world_model
-    stoch_flat = (
-        stoch_width
-        if stoch_width is not None
-        else int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
-    )
-    R = int(wm_cfg.recurrent_model.recurrent_state_size)
-    base_expl = float(cfg.algo.actor.expl_amount if cfg.select("algo.actor.expl_amount") else 0.0)
-    expl_decay = float(cfg.algo.actor.expl_decay if cfg.select("algo.actor.expl_decay") else 0.0)
-    expl_min = float(cfg.algo.actor.expl_min if cfg.select("algo.actor.expl_min") else 0.0)
-    use_expl = base_expl > 0.0 or expl_min > 0.0
-
-    def expl_amount_at(step_count: int) -> float:
-        """Exploration schedule (reference Actor._get_expl_amount :499-503;
-        the reference's `0.5 ** step / decay` has a precedence quirk — we use
-        the intended half-life decay `0.5 ** (step / decay)`)."""
-        amount = base_expl
-        if expl_decay:
-            amount *= 0.5 ** (float(step_count) / expl_decay)
-        return max(amount, expl_min)
-
-    @jax.jit
-    def init_state(mask=None, state=None):
-        h0 = jnp.zeros((num_envs, R))
-        z0 = jnp.zeros((num_envs, stoch_flat))
-        a0 = jnp.zeros((num_envs, int(sum(actions_dim))))
-        if state is None or mask is None:
-            return (h0, z0, a0)
-        h, z, a = state
-        m = mask[:, None]
-        return (jnp.where(m, h0, h), jnp.where(m, z0, z), jnp.where(m, a0, a))
-
-    @partial(jax.jit, static_argnames=("greedy",))
-    def step(params, obs, state, key, greedy=False, expl_amount=0.0):
-        h, z, a = state
-        obs = normalize_obs(obs, cnn_keys)
-        embedded = wm.apply({"params": params["wm"]}, obs, method="embed")
-        h = wm.apply(
-            {"params": params["wm"]},
-            jnp.concatenate([z, a], -1),
-            h,
-            method="recurrent_step",
-        )
-        k1, k2, k3 = jax.random.split(key, 3)
-        z = wm.apply({"params": params["wm"]}, h, embedded, k1, method="representation_step")
-        pre = actor.apply({"params": params["actor"]}, jnp.concatenate([z, h], -1))
-        acts, _ = dv2_sample_actions(actor, pre, k2, greedy=greedy)
-        if not greedy and use_expl:
-            acts = dv2_exploration_noise(actor, acts, expl_amount, k3)
-        a = jnp.concatenate(acts, -1)
-        if is_continuous:
-            env_actions = a
-        else:
-            env_actions = jnp.stack([jnp.argmax(x, axis=-1) for x in acts], axis=-1)
-        return env_actions, a, (h, z, a)
-
-    return init_state, step, expl_amount_at
-
-
-def _build_buffer(cfg: Config, num_envs: int, obs_keys, log_dir: str, rank: int):
-    """`buffer.type` selects sequential vs episode replay (reference :496-517)."""
-    seq_len = int(cfg.algo.per_rank_sequence_length)
-    buffer_size = int(cfg.buffer.size) if not cfg.dry_run else max(4 * seq_len, 64)
-    buffer_type = str(cfg.buffer.type if cfg.select("buffer.type") else "sequential").lower()
-    memmap_dir = (
-        os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None
-    )
-    if buffer_type == "sequential":
-        return EnvIndependentReplayBuffer(
-            buffer_size,
-            n_envs=num_envs,
-            obs_keys=obs_keys,
-            memmap=cfg.buffer.memmap,
-            memmap_dir=memmap_dir,
-            buffer_cls=SequentialReplayBuffer,
-        )
-    if buffer_type == "episode":
-        return EpisodeBuffer(
-            buffer_size,
-            minimum_episode_length=1 if cfg.dry_run else int(cfg.algo.per_rank_sequence_length),
-            n_envs=num_envs,
-            obs_keys=obs_keys,
-            prioritize_ends=bool(cfg.buffer.prioritize_ends)
-            if cfg.select("buffer.prioritize_ends")
-            else False,
-            memmap=cfg.buffer.memmap,
-            memmap_dir=memmap_dir,
-        )
-    raise ValueError(
-        f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}"
+    """Device-resident player (replaces reference PlayerDV1, agent.py:219-298).
+    Identical to the DV2 player apart from the Gaussian stochastic-state
+    width, so it delegates to the shared factory."""
+    return make_dreamer_player(
+        wm,
+        actor,
+        cfg,
+        actions_dim,
+        is_continuous,
+        num_envs,
+        stoch_width=int(cfg.algo.world_model.stochastic_size),
     )
 
 
-@register_algorithm(name="dreamer_v2")
+@register_algorithm(name="dreamer_v1")
 def main(dist: Distributed, cfg: Config) -> None:
     root_key = dist.seed_everything(cfg.seed)
     rank = dist.process_index
@@ -444,14 +304,22 @@ def main(dist: Distributed, cfg: Config) -> None:
             "wm": txs["wm"].init(params["wm"]),
             "actor": txs["actor"].init(params["actor"]),
             "critic": txs["critic"].init(params["critic"]),
-            "step": jnp.zeros((), jnp.int32),
         }
 
     seq_len = int(cfg.algo.per_rank_sequence_length)
-    rb = _build_buffer(cfg, num_envs, obs_keys, log_dir, rank)
+    buffer_size = int(cfg.buffer.size) if not cfg.dry_run else max(4 * seq_len, 64)
+    rb = EnvIndependentReplayBuffer(
+        buffer_size,
+        n_envs=num_envs,
+        obs_keys=obs_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}")
+        if cfg.buffer.memmap
+        else None,
+        buffer_cls=SequentialReplayBuffer,
+    )
     if state and cfg.buffer.checkpoint and "rb" in state:
         rb.load_state_dict(state["rb"])
-    buffer_type = str(cfg.buffer.type if cfg.select("buffer.type") else "sequential").lower()
 
     train = make_train_fn(wm, actor, critic, txs, cfg, is_continuous, actions_dim)
     player_init, player_step_fn, expl_amount_at = make_player(
@@ -477,7 +345,8 @@ def main(dist: Distributed, cfg: Config) -> None:
     obs, _ = envs.reset(seed=cfg.seed)
     player_state = player_init()
 
-    # row 0: reset obs, zero action/reward, is_first=1 (reference :548-563)
+    # row 0: reset obs, zero action/reward (reference :545-556 — DV1 stores no
+    # is_first; its RSSM never resets mid-sequence)
     step_data: Dict[str, np.ndarray] = {}
     for k in obs_keys:
         step_data[k] = np.asarray(obs[k])[np.newaxis]
@@ -485,7 +354,6 @@ def main(dist: Distributed, cfg: Config) -> None:
     step_data["rewards"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
-    step_data["is_first"] = np.ones((1, num_envs, 1), np.float32)
     rb.add(step_data)
 
     while policy_step < total_steps:
@@ -503,8 +371,10 @@ def main(dist: Distributed, cfg: Config) -> None:
             else:
                 device_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
                 root_key, k = jax.random.split(root_key)
+                expl_amount = expl_amount_at(policy_step)
+                aggregator.update("Params/exploration_amount", expl_amount)
                 env_actions, actions_cat, player_state = player_step_fn(
-                    params, device_obs, player_state, k, expl_amount=expl_amount_at(policy_step)
+                    params, device_obs, player_state, k, expl_amount=expl_amount
                 )
                 actions_np = np.asarray(actions_cat)
                 actions_env = np.asarray(env_actions)
@@ -513,18 +383,9 @@ def main(dist: Distributed, cfg: Config) -> None:
                 elif not is_multidiscrete:
                     actions_env = actions_env.reshape(num_envs)
 
-            # is_first of the *next* row = this step ended an episode
-            # (reference :624 `is_first = terminated | truncated` of prev step)
-            prev_done = np.logical_or(step_data["terminated"], step_data["truncated"]).astype(
-                np.float32
-            )
             next_obs, rewards, terminated, truncated, info = envs.step(actions_env)
             policy_step += num_envs
             dones = np.logical_or(terminated, truncated)
-            if cfg.dry_run and buffer_type == "episode":
-                terminated = np.ones_like(terminated)
-                truncated = np.ones_like(truncated)
-                dones = np.ones_like(dones)
 
             for ep_rew, ep_len in episode_stats(info):
                 aggregator.update("Rewards/rew_avg", ep_rew)
@@ -539,7 +400,6 @@ def main(dist: Distributed, cfg: Config) -> None:
 
             for k in obs_keys:
                 step_data[k] = real_next_obs[k][np.newaxis]
-            step_data["is_first"] = prev_done
             step_data["terminated"] = np.asarray(terminated, np.float32).reshape(1, num_envs, 1)
             step_data["truncated"] = np.asarray(truncated, np.float32).reshape(1, num_envs, 1)
             step_data["actions"] = actions_np.reshape(1, num_envs, -1)
@@ -625,7 +485,6 @@ def main(dist: Distributed, cfg: Config) -> None:
                 "world_model": params["wm"],
                 "actor": params["actor"],
                 "critic": params["critic"],
-                "target_critic": params["target_critic"],
             },
             log_dir,
         )
@@ -633,8 +492,8 @@ def main(dist: Distributed, cfg: Config) -> None:
         logger.close()
 
 
-@register_evaluation(algorithms="dreamer_v2")
-def evaluate_dreamer_v2(dist: Distributed, cfg: Config, state: Dict[str, Any]) -> None:
+@register_evaluation(algorithms="dreamer_v1")
+def evaluate_dreamer_v1(dist: Distributed, cfg: Config, state: Dict[str, Any]) -> None:
     log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
     logger = get_logger(cfg, log_dir, dist.process_index)
     env = vectorize(cfg, cfg.seed, 0, log_dir).envs[0]

@@ -1,0 +1,53 @@
+"""DreamerV1 losses (reference sheeprl/algos/dreamer_v1/loss.py, 95 LoC).
+
+The world-model loss is Eq. 10 of https://arxiv.org/abs/1912.01603: Gaussian
+reconstruction + Gaussian KL clamped below by free nats. Unlike DV2 there is
+no KL balancing — a single full-gradient KL(posterior ‖ prior).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...distributions import Distribution, kl_divergence
+
+
+def critic_loss(qv: Distribution, lambda_values: jax.Array, discount: jax.Array) -> jax.Array:
+    """-E[discount · log q(λ)] (reference loss.py:9-24)."""
+    return -jnp.mean(discount * qv.log_prob(lambda_values))
+
+
+def actor_loss(discounted_lambda_values: jax.Array) -> jax.Array:
+    """-E[λ-values] (reference loss.py:27-38)."""
+    return -jnp.mean(discounted_lambda_values)
+
+
+def reconstruction_loss(
+    qo: Dict[str, Distribution],
+    observations: Dict[str, jax.Array],
+    qr: Distribution,
+    rewards: jax.Array,
+    posteriors_dist: Distribution,
+    priors_dist: Distribution,
+    kl_free_nats: float = 3.0,
+    kl_regularizer: float = 1.0,
+    qc: Optional[Distribution] = None,
+    continue_targets: Optional[jax.Array] = None,
+    continue_scale_factor: float = 10.0,
+) -> Tuple[jax.Array, ...]:
+    """World-model loss (reference loss.py:41-95). Note: the reference adds
+    `+scale · log_prob(continues)` (loss.py:92) where the BCE term should be
+    *negative* log-likelihood; we use -log_prob (the continue model is off by
+    default in DV1, configs/algo/dreamer_v1.yaml:36)."""
+    observation_loss = -sum(qo[k].log_prob(observations[k]).mean() for k in qo)
+    reward_loss = -qr.log_prob(rewards).mean()
+    kl = kl_divergence(posteriors_dist, priors_dist).mean()
+    state_loss = jnp.maximum(kl, kl_free_nats)
+    if qc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * -qc.log_prob(continue_targets).mean()
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    total = kl_regularizer * state_loss + observation_loss + reward_loss + continue_loss
+    return total, kl, state_loss, reward_loss, observation_loss, continue_loss

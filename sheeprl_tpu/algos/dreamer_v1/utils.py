@@ -1,0 +1,58 @@
+"""DreamerV1 per-algo contract (reference sheeprl/algos/dreamer_v1/utils.py).
+
+`compute_lambda_values` reproduces the reference recursion (:42-78) exactly —
+including its horizon-1 output length and the `(1-λ)`-free bootstrap at the
+last step — but as a reverse `lax.scan`. Observation preparation and the test
+rollout are shared with DreamerV2 (the reference imports them from
+dreamer_v2/utils.py too, dreamer_v1.py:23).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..dreamer_v2.utils import normalize_obs, prepare_obs, test  # noqa: F401 — shared
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "State/kl",
+    "Params/exploration_amount",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic"}
+
+
+def compute_lambda_values(
+    rewards: jax.Array,  # [H, B, 1]
+    values: jax.Array,  # [H, B, 1]
+    continues: jax.Array,  # [H, B, 1]
+    last_values: jax.Array,  # [B, 1]
+    horizon: int = 15,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """TD(λ) targets, DV1 flavor (reference dreamer_v1/utils.py:42-78):
+    H-1 outputs; next-values are `values[s+1]·(1-λ)` except the final step,
+    which bootstraps with the *unscaled* `last_values`."""
+    next_values = jnp.concatenate(
+        [values[1 : horizon - 1] * (1 - lmbda), last_values[None]], axis=0
+    )
+    deltas = rewards[: horizon - 1] + next_values * continues[: horizon - 1]
+
+    def step(agg, xs):
+        delta, cont = xs
+        agg = delta + lmbda * cont * agg
+        return agg, agg
+
+    _, lvs = jax.lax.scan(
+        step, jnp.zeros_like(last_values), (deltas, continues[: horizon - 1]), reverse=True
+    )
+    return lvs

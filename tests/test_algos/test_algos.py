@@ -114,6 +114,32 @@ def test_dreamer_v2(standard_args, env_id, buffer_type, distribution):
     )
 
 
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_dreamer_v1(standard_args, env_id):
+    _run(
+        [
+            "exp=dreamer_v1",
+            "env=dummy",
+            f"env.id={env_id}",
+            "algo.per_rank_batch_size=2",
+            "algo.per_rank_sequence_length=2",
+            "algo.learning_starts=0",
+            "algo.horizon=4",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=16",
+            "algo.world_model.transition_model.hidden_size=16",
+            "algo.world_model.representation_model.hidden_size=16",
+            "algo.world_model.stochastic_size=4",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.size=64",
+        ],
+        standard_args,
+    )
+
+
 def test_sac_ae(standard_args):
     _run(
         [
