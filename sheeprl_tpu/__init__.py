@@ -23,6 +23,7 @@ from sheeprl_tpu.algos import (  # noqa: F401,E402
     dreamer_v3,
     droq,
     ppo,
+    ppo_recurrent,
     sac,
     sac_ae,
 )

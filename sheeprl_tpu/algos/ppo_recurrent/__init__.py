@@ -1,0 +1,1 @@
+from . import ppo_recurrent  # noqa: F401 — registers the algorithm

@@ -115,6 +115,28 @@ def test_dreamer_v2(standard_args, env_id, buffer_type, distribution):
 
 
 @pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_ppo_recurrent(standard_args, env_id):
+    _run(
+        [
+            "exp=ppo_recurrent",
+            "env=dummy",
+            f"env.id={env_id}",
+            "algo.rollout_steps=8",
+            "algo.per_rank_sequence_length=4",
+            "algo.per_rank_num_batches=2",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.encoder.cnn_features_dim=16",
+            "algo.dense_units=8",
+            "algo.rnn.lstm.hidden_size=8",
+            "algo.mlp_layers=1",
+        ],
+        standard_args,
+    )
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
 def test_dreamer_v1(standard_args, env_id):
     _run(
         [
