@@ -22,6 +22,9 @@ from sheeprl_tpu.algos import (  # noqa: F401,E402
     dreamer_v2,
     dreamer_v3,
     droq,
+    p2e_dv1,
+    p2e_dv2,
+    p2e_dv3,
     ppo,
     ppo_recurrent,
     sac,

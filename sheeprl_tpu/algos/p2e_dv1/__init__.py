@@ -1,0 +1,1 @@
+from . import p2e_dv1_exploration, p2e_dv1_finetuning  # noqa: F401 — registers

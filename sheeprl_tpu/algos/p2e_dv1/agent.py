@@ -1,0 +1,105 @@
+"""Plan2Explore-DV1 agent (reference sheeprl/algos/p2e_dv1/agent.py, 155 LoC).
+
+Wraps the DreamerV1 world model with *two* actor-critic pairs (task +
+exploration) and an ensemble of next-embedding predictors whose disagreement
+is the intrinsic reward (reference build_agent :26-155). The ensembles are a
+single vmapped MLP stack (see models/ensembles.py) instead of a ModuleList.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models import build_ensembles
+from ..dreamer_v1.agent import DV1WorldModel, build_agent as dv1_build_agent
+from ..dreamer_v2.agent import DV2Actor, DV2Head
+
+Actor = DV2Actor  # reference aliases (agent.py:22-23)
+
+
+def _embedded_obs_dim(cfg: Any, observation_space: gym.spaces.Dict) -> int:
+    """Encoder output width: cnn flat dim + mlp dense_units (reference uses
+    `encoder.cnn_output_dim + encoder.mlp_output_dim`, agent.py:135)."""
+    dim = 0
+    if tuple(cfg.algo.cnn_keys.encoder):
+        dim += 8 * int(cfg.algo.world_model.encoder.cnn_channels_multiplier) * 2 * 2
+    if tuple(cfg.algo.mlp_keys.encoder):
+        dim += int(cfg.algo.world_model.encoder.dense_units)
+    return dim
+
+
+def build_agent(
+    dist: Any,
+    cfg: Any,
+    observation_space: gym.spaces.Dict,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    key: jax.Array,
+    state: Optional[Dict[str, Any]] = None,
+):
+    """Returns (wm, actor, critic, ensembles_apply, params) with params =
+    {wm, actor_task, critic_task, actor_exploration, critic_exploration,
+    ensembles}. `actor`/`critic` are the (shared-architecture) module defs
+    used for both the task and exploration pairs."""
+    k_dv1, k_task_a, k_task_c, k_ens = jax.random.split(key, 4)
+    wm_cfg = cfg.algo.world_model
+    latent_size = int(wm_cfg.stochastic_size) + int(wm_cfg.recurrent_model.recurrent_state_size)
+
+    # exploration pair rides the plain DV1 build
+    wm, actor, critic, dv1_params = dv1_build_agent(
+        dist,
+        cfg,
+        observation_space,
+        actions_dim,
+        is_continuous,
+        k_dv1,
+        {
+            "wm": state["wm"],
+            "actor": state["actor_exploration"],
+            "critic": state["critic_exploration"],
+        }
+        if state
+        else None,
+    )
+
+    ens_in = int(sum(actions_dim)) + latent_size
+    ens_out = _embedded_obs_dim(cfg, observation_space)
+    ens_apply, ens_params = build_ensembles(
+        k_ens,
+        n=int(cfg.algo.ensembles.n),
+        input_dim=ens_in,
+        output_dim=ens_out,
+        mlp_layers=int(cfg.algo.ensembles.mlp_layers),
+        dense_units=int(cfg.algo.ensembles.dense_units),
+        activation=str(cfg.algo.ensembles.dense_act),
+    )
+
+    if state is not None:
+        params = {
+            "wm": dv1_params["wm"],
+            "actor_task": state["actor_task"],
+            "critic_task": state["critic_task"],
+            "actor_exploration": dv1_params["actor"],
+            "critic_exploration": dv1_params["critic"],
+            "ensembles": state["ensembles"],
+        }
+    else:
+        actor_task_params = actor.init(k_task_a, jnp.zeros((1, latent_size)))["params"]
+        critic_task_params = critic.init(k_task_c, jnp.zeros((1, latent_size)))["params"]
+        params = {
+            "wm": dv1_params["wm"],
+            "actor_task": actor_task_params,
+            "critic_task": critic_task_params,
+            "actor_exploration": dv1_params["actor"],
+            "critic_exploration": dv1_params["critic"],
+            "ensembles": ens_params,
+        }
+    params = dist.replicate(params)
+    return wm, actor, critic, ens_apply, params
+
+
+__all__ = ["Actor", "build_agent", "_embedded_obs_dim"]

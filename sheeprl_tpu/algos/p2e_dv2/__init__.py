@@ -1,0 +1,1 @@
+from . import p2e_dv2_exploration, p2e_dv2_finetuning  # noqa: F401 — registers

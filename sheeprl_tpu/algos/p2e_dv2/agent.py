@@ -1,0 +1,94 @@
+"""Plan2Explore-DV2 agent (reference sheeprl/algos/p2e_dv2/agent.py, 209 LoC).
+
+DreamerV2 world model + task and exploration actor-critic pairs (each critic
+with a hard-copy target network) + a vmapped ensemble stack predicting the
+next discrete stochastic state (reference build_agent :26-209).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models import build_ensembles
+from ..dreamer_v2.agent import DV2Actor, build_agent as dv2_build_agent
+
+Actor = DV2Actor
+
+__all__ = ["Actor", "build_agent"]
+
+
+def build_agent(
+    dist: Any,
+    cfg: Any,
+    observation_space: gym.spaces.Dict,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    key: jax.Array,
+    state: Optional[Dict[str, Any]] = None,
+):
+    """Returns (wm, actor, critic, ens_apply, params) with params =
+    {wm, actor_task, critic_task, target_critic_task, actor_exploration,
+    critic_exploration, target_critic_exploration, ensembles}."""
+    k_dv2, k_expl_a, k_expl_c, k_ens = jax.random.split(key, 4)
+    wm_cfg = cfg.algo.world_model
+    stoch_flat = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
+    latent_size = stoch_flat + int(wm_cfg.recurrent_model.recurrent_state_size)
+
+    wm, actor, critic, dv2_params = dv2_build_agent(
+        dist,
+        cfg,
+        observation_space,
+        actions_dim,
+        is_continuous,
+        k_dv2,
+        {
+            "wm": state["wm"],
+            "actor": state["actor_task"],
+            "critic": state["critic_task"],
+            "target_critic": state["target_critic_task"],
+        }
+        if state
+        else None,
+    )
+
+    # ensembles predict the next stochastic state (reference agent.py:150-176)
+    ens_apply, ens_params = build_ensembles(
+        k_ens,
+        n=int(cfg.algo.ensembles.n),
+        input_dim=int(sum(actions_dim)) + latent_size,
+        output_dim=stoch_flat,
+        mlp_layers=int(cfg.algo.ensembles.mlp_layers),
+        dense_units=int(cfg.algo.ensembles.dense_units),
+        activation=str(cfg.algo.ensembles.dense_act),
+    )
+
+    if state is not None:
+        params = {
+            "wm": dv2_params["wm"],
+            "actor_task": dv2_params["actor"],
+            "critic_task": dv2_params["critic"],
+            "target_critic_task": dv2_params["target_critic"],
+            "actor_exploration": state["actor_exploration"],
+            "critic_exploration": state["critic_exploration"],
+            "target_critic_exploration": state["target_critic_exploration"],
+            "ensembles": state["ensembles"],
+        }
+    else:
+        actor_expl = actor.init(k_expl_a, jnp.zeros((1, latent_size)))["params"]
+        critic_expl = critic.init(k_expl_c, jnp.zeros((1, latent_size)))["params"]
+        params = {
+            "wm": dv2_params["wm"],
+            "actor_task": dv2_params["actor"],
+            "critic_task": dv2_params["critic"],
+            "target_critic_task": dv2_params["target_critic"],
+            "actor_exploration": actor_expl,
+            "critic_exploration": critic_expl,
+            "target_critic_exploration": jax.tree.map(jnp.copy, critic_expl),
+            "ensembles": ens_params,
+        }
+    params = dist.replicate(params)
+    return wm, actor, critic, ens_apply, params

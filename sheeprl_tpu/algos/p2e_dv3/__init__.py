@@ -1,0 +1,1 @@
+from . import p2e_dv3_exploration, p2e_dv3_finetuning  # noqa: F401 — registers

@@ -1,0 +1,100 @@
+"""Plan2Explore-DV3 agent (reference sheeprl/algos/p2e_dv3/agent.py, 223 LoC).
+
+DreamerV3 world model + task actor-critic (with target critic) + exploration
+actor + a *dict* of exploration critics — one per reward stream
+(`cfg.algo.critics_exploration`: intrinsic / extrinsic, each with its own
+target network and Moments normalizer, reference build_agent :26-223) — and
+a vmapped ensemble stack predicting the next stochastic state.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models import build_ensembles
+from ..dreamer_v3.agent import Actor, DV3Head, build_agent as dv3_build_agent
+
+__all__ = ["Actor", "build_agent"]
+
+
+def build_agent(
+    dist: Any,
+    cfg: Any,
+    observation_space: gym.spaces.Dict,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    key: jax.Array,
+    state: Optional[Dict[str, Any]] = None,
+):
+    """Returns (wm, actor, critic, ens_apply, params) with params =
+    {wm, actor_task, critic_task, target_critic_task, actor_exploration,
+    critics_exploration: {name: {critic, target}}, ensembles}."""
+    k_dv3, k_expl_a, k_ens = jax.random.split(key, 3)
+    wm_cfg = cfg.algo.world_model
+    stoch_flat = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
+    latent_size = stoch_flat + int(wm_cfg.recurrent_model.recurrent_state_size)
+    critic_names = list((cfg.algo.critics_exploration or {}).keys())
+
+    wm, actor, critic, dv3_params = dv3_build_agent(
+        dist,
+        cfg,
+        observation_space,
+        actions_dim,
+        is_continuous,
+        k_dv3,
+        {
+            "wm": state["wm"],
+            "actor": state["actor_task"],
+            "critic": state["critic_task"],
+            "target_critic": state["target_critic_task"],
+        }
+        if state
+        else None,
+    )
+
+    # ensembles predict the next stochastic state (reference agent.py:170-189)
+    ens_apply, ens_params = build_ensembles(
+        k_ens,
+        n=int(cfg.algo.ensembles.n),
+        input_dim=int(sum(actions_dim)) + latent_size,
+        output_dim=stoch_flat,
+        mlp_layers=int(cfg.algo.ensembles.mlp_layers),
+        dense_units=int(cfg.algo.ensembles.dense_units),
+        activation=str(cfg.algo.ensembles.dense_act),
+    )
+
+    if state is not None:
+        params = {
+            "wm": dv3_params["wm"],
+            "actor_task": dv3_params["actor"],
+            "critic_task": dv3_params["critic"],
+            "target_critic_task": dv3_params["target_critic"],
+            "actor_exploration": state["actor_exploration"],
+            "critics_exploration": state["critics_exploration"],
+            "ensembles": state["ensembles"],
+        }
+    else:
+        keys = jax.random.split(k_expl_a, 1 + len(critic_names))
+        actor_expl_params = actor.init(keys[0], jnp.zeros((1, latent_size)))["params"]
+        critics_expl = {}
+        for i, name in enumerate(critic_names):
+            c_params = critic.init(keys[1 + i], jnp.zeros((1, latent_size)))["params"]
+            critics_expl[name] = {
+                "critic": c_params,
+                "target": jax.tree.map(jnp.copy, c_params),
+            }
+        params = {
+            "wm": dv3_params["wm"],
+            "actor_task": dv3_params["actor"],
+            "critic_task": dv3_params["critic"],
+            "target_critic_task": dv3_params["target_critic"],
+            "actor_exploration": actor_expl_params,
+            "critics_exploration": critics_expl,
+            "ensembles": ens_params,
+        }
+    params = dist.replicate(params)
+    return wm, actor, critic, ens_apply, params

@@ -1,0 +1,294 @@
+"""Plan2Explore-DV3, few-shot finetuning phase.
+
+Reference sheeprl/algos/p2e_dv3/p2e_dv3_finetuning.py (477 LoC): load the
+exploration checkpoint, collect with the exploration actor until
+`learning_starts`, then switch the player to the task actor and train
+world model + task actor/critic with the plain DreamerV3 update.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...config import Config, instantiate
+from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from ...optim import clipped
+from ...parallel import Distributed
+from ...utils.checkpoint import CheckpointManager
+from ...utils.env import episode_stats, vectorize
+from ...utils.logger import get_log_dir, get_logger
+from ...utils.metric import MetricAggregator
+from ...utils.registry import register_algorithm
+from ...utils.timer import timer
+from ...utils.utils import Ratio, save_configs
+from ..dreamer_v3.agent import build_agent as dv3_build_agent
+from ..dreamer_v3.dreamer_v3 import make_player, make_train_fn
+from ..dreamer_v3.utils import AGGREGATOR_KEYS, init_moments, prepare_obs, test  # noqa: F401
+
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moments"}
+
+
+@register_algorithm(name="p2e_dv3_finetuning", requires_exploration_cfg=True)
+def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
+    # inherit the exploration run's architecture (reference :52-70)
+    for k in (
+        "gamma", "lmbda", "horizon", "layer_norm", "dense_units", "mlp_layers", "dense_act",
+        "cnn_act", "unimix", "hafner_initialization", "world_model", "actor", "critic",
+        "cnn_keys", "mlp_keys",
+    ):
+        if exploration_cfg.select(f"algo.{k}") is not None:
+            cfg.set_path(f"algo.{k}", exploration_cfg.select(f"algo.{k}"))
+
+    root_key = dist.seed_everything(cfg.seed)
+    rank = dist.process_index
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
+    logger = get_logger(cfg, log_dir, rank)
+    if rank == 0:
+        save_configs(cfg, log_dir)
+
+    resume = bool(cfg.checkpoint.resume_from)
+    if resume:
+        state = CheckpointManager.load(cfg.checkpoint.resume_from)
+        params_in = state["params"]
+        actor_exploration_params = state["actor_exploration"]
+        moments = state["moments"]
+    else:
+        state = None
+        explo_state = CheckpointManager.load(cfg.checkpoint.exploration_ckpt_path)
+        params_in = {
+            "wm": explo_state["params"]["wm"],
+            "actor": explo_state["params"]["actor_task"],
+            "critic": explo_state["params"]["critic_task"],
+            "target_critic": explo_state["params"]["target_critic_task"],
+        }
+        actor_exploration_params = explo_state["params"]["actor_exploration"]
+        moments = explo_state["moments"]["task"]
+
+    envs = vectorize(cfg, cfg.seed, rank, log_dir)
+    obs_space = envs.single_observation_space
+    action_space = envs.single_action_space
+    num_envs = int(cfg.env.num_envs)
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    if is_continuous:
+        actions_dim = [int(np.prod(action_space.shape))]
+    elif is_multidiscrete:
+        actions_dim = [int(n) for n in action_space.nvec]
+    else:
+        actions_dim = [int(action_space.n)]
+    act_total = int(sum(actions_dim))
+
+    root_key, init_key = jax.random.split(root_key)
+    wm, actor, critic, params = dv3_build_agent(
+        dist, cfg, obs_space, actions_dim, is_continuous, init_key, params_in
+    )
+    actor_exploration_params = dist.replicate(actor_exploration_params)
+
+    txs = {
+        "wm": clipped(instantiate(cfg.algo.world_model.optimizer), cfg.algo.world_model.clip_gradients),
+        "actor": clipped(instantiate(cfg.algo.actor.optimizer), cfg.algo.actor.clip_gradients),
+        "critic": clipped(instantiate(cfg.algo.critic.optimizer), cfg.algo.critic.clip_gradients),
+    }
+    if state:
+        opt_states = state["opt_states"]
+    else:
+        opt_states = {k: txs[k].init(params[k]) for k in txs}
+        opt_states["step"] = jnp.zeros((), jnp.int32)
+
+    seq_len = int(cfg.algo.per_rank_sequence_length)
+    buffer_size = int(cfg.buffer.size) if not cfg.dry_run else max(4 * seq_len, 64)
+    rb = EnvIndependentReplayBuffer(
+        buffer_size,
+        n_envs=num_envs,
+        obs_keys=obs_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}")
+        if cfg.buffer.memmap
+        else None,
+        buffer_cls=SequentialReplayBuffer,
+    )
+    if resume and cfg.buffer.checkpoint and "rb" in state:
+        rb.load_state_dict(state["rb"])
+    elif not resume and cfg.select("buffer.load_from_exploration") and "rb" in explo_state:
+        rb.load_state_dict(explo_state["rb"])
+
+    train = make_train_fn(wm, actor, critic, txs, cfg, is_continuous, actions_dim)
+    player_init, player_step_fn = make_player(wm, actor, cfg, actions_dim, is_continuous, num_envs)
+
+    aggregator = MetricAggregator(
+        {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
+    )
+    ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state and "ratio" in state:
+        ratio.load_state_dict(state["ratio"])
+
+    batch_size = int(cfg.algo.per_rank_batch_size) * dist.world_size
+    total_steps = int(cfg.algo.total_steps) if not cfg.dry_run else 4 * num_envs
+    learning_starts = int(cfg.algo.learning_starts) if not cfg.dry_run else 0
+    policy_step = state["policy_step"] if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+    actor_type = str(cfg.algo.player.actor_type)
+
+    def step_params():
+        if actor_type == "task":
+            return {"wm": params["wm"], "actor": params["actor"]}
+        return {"wm": params["wm"], "actor": actor_exploration_params}
+
+    obs, _ = envs.reset(seed=cfg.seed)
+    player_state = player_init(step_params())
+
+    step_data: Dict[str, np.ndarray] = {}
+    for k in obs_keys:
+        step_data[k] = np.asarray(obs[k])[np.newaxis]
+    step_data["actions"] = np.zeros((1, num_envs, act_total), np.float32)
+    step_data["rewards"] = np.zeros((1, num_envs, 1), np.float32)
+    step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
+    step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
+    step_data["is_first"] = np.ones((1, num_envs, 1), np.float32)
+
+    while policy_step < total_steps:
+        with timer("Time/env_interaction_time"):
+            if policy_step >= learning_starts and actor_type != "task":
+                actor_type = "task"  # reference :330-331
+            device_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
+            root_key, k = jax.random.split(root_key)
+            env_actions, actions_cat, player_state = player_step_fn(
+                step_params(), device_obs, player_state, k
+            )
+            actions_np = np.asarray(actions_cat)
+            actions_env = np.asarray(env_actions)
+            if is_continuous:
+                actions_env = actions_env.reshape(num_envs, -1)
+            elif not is_multidiscrete:
+                actions_env = actions_env.reshape(num_envs)
+
+            step_data["actions"] = actions_np.reshape(1, num_envs, -1)
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+            next_obs, rewards, terminated, truncated, info = envs.step(actions_env)
+            policy_step += num_envs
+            dones = np.logical_or(terminated, truncated)
+
+            for ep_rew, ep_len in episode_stats(info):
+                aggregator.update("Rewards/rew_avg", ep_rew)
+                aggregator.update("Game/ep_len_avg", ep_len)
+
+            real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+            if "final_obs" in info:
+                for i, fo in enumerate(info["final_obs"]):
+                    if fo is not None:
+                        for k in obs_keys:
+                            real_next_obs[k][i] = np.asarray(fo[k])
+
+            for k in obs_keys:
+                step_data[k] = np.asarray(next_obs[k])[np.newaxis]
+            step_data["is_first"] = np.zeros((1, num_envs, 1), np.float32)
+            step_data["terminated"] = np.asarray(terminated, np.float32).reshape(1, num_envs, 1)
+            step_data["truncated"] = np.asarray(truncated, np.float32).reshape(1, num_envs, 1)
+            step_data["rewards"] = clip_rewards_fn(
+                np.asarray(rewards, np.float32).reshape(1, num_envs, 1)
+            )
+
+            dones_idxes = np.nonzero(dones)[0].tolist()
+            if dones_idxes:
+                reset_data: Dict[str, np.ndarray] = {}
+                for k in obs_keys:
+                    reset_data[k] = real_next_obs[k][dones_idxes][np.newaxis]
+                reset_data["terminated"] = step_data["terminated"][:, dones_idxes]
+                reset_data["truncated"] = step_data["truncated"][:, dones_idxes]
+                reset_data["actions"] = np.zeros((1, len(dones_idxes), act_total), np.float32)
+                reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
+                reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
+                rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+                step_data["rewards"][:, dones_idxes] = 0
+                step_data["terminated"][:, dones_idxes] = 0
+                step_data["truncated"][:, dones_idxes] = 0
+                step_data["is_first"][:, dones_idxes] = 1
+                mask = np.zeros((num_envs,), bool)
+                mask[dones_idxes] = True
+                player_state = player_init(step_params(), jnp.asarray(mask), player_state)
+
+            obs = next_obs
+
+        if policy_step >= learning_starts:
+            per_rank_gradient_steps = ratio(policy_step / dist.world_size)
+            if per_rank_gradient_steps > 0:
+                with timer("Time/train_time"):
+                    sharding = dist.sharding(None, "dp")
+                    for _ in range(per_rank_gradient_steps):
+                        sample = rb.sample(batch_size, sequence_length=seq_len, n_samples=1)
+                        batch = {
+                            k: jax.device_put(np.asarray(v[0]), sharding) for k, v in sample.items()
+                        }
+                        root_key, tk = jax.random.split(root_key)
+                        params, opt_states, moments, metrics = train(
+                            params, opt_states, moments, batch, tk
+                        )
+                for k, v in metrics.items():
+                    aggregator.update(k, np.asarray(v))
+
+        if rank == 0 and logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
+            logger.log_metrics(aggregator.compute(), policy_step)
+            aggregator.reset()
+            timer.reset()
+            last_log = policy_step
+
+        if (
+            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
+        ) or cfg.dry_run or policy_step >= total_steps:
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "params": params,
+                "actor_exploration": actor_exploration_params,
+                "opt_states": opt_states,
+                "moments": moments,
+                "ratio": ratio.state_dict(),
+                "policy_step": policy_step,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+                "rng": root_key,
+            }
+            if cfg.buffer.checkpoint:
+                ckpt_state["rb"] = rb.state_dict()
+            ckpt.save(policy_step, ckpt_state)
+
+    envs.close()
+    if rank == 0 and cfg.algo.run_test:
+        test_cfg = Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}})
+        test_env = vectorize(test_cfg, cfg.seed, rank, log_dir).envs[0]
+        t_init, t_step = make_player(wm, actor, cfg, actions_dim, is_continuous, 1)
+        t_state = t_init(params)
+
+        def _step(o, s, k, greedy):
+            env_actions, _, s = t_step(params, o, s, k, greedy)
+            return env_actions, s
+
+        test(_step, t_state, test_env, cfg, log_dir, logger)
+    if rank == 0 and not cfg.model_manager.disabled:
+        from ...utils.model_manager import register_model
+
+        register_model(
+            cfg,
+            {
+                "world_model": params["wm"],
+                "actor": params["actor"],
+                "critic": params["critic"],
+                "target_critic": params["target_critic"],
+                "moments": moments,
+            },
+            log_dir,
+        )
+    if logger is not None:
+        logger.close()

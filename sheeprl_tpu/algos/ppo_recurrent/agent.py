@@ -175,7 +175,11 @@ def build_agent(
         cnn_features_dim=int(enc.cnn_features_dim),
         mlp_features_dim=int(enc.mlp_features_dim),
         encoder_dense_units=int(enc.dense_units),
-        encoder_mlp_layers=int(enc.mlp_layers if cfg.select("algo.encoder.mlp_layers") else cfg.algo.mlp_layers),
+        encoder_mlp_layers=int(
+            enc.mlp_layers
+            if cfg.select("algo.encoder.mlp_layers") is not None
+            else cfg.algo.mlp_layers
+        ),
         dense_act=str(cfg.algo.dense_act),
         layer_norm=bool(cfg.algo.layer_norm),
         lstm_hidden_size=int(rnn.lstm.hidden_size),

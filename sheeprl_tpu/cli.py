@@ -93,6 +93,31 @@ def run_algorithm(cfg: Config) -> None:
     entry = get_algorithm(cfg.algo.name)
     module = importlib.import_module(entry["module"])
     fn = getattr(module, entry["entrypoint"])
+    kwargs: Dict[str, Any] = {}
+    if entry.get("requires_exploration_cfg"):
+        # exploration→finetuning config surgery (reference cli.py:117-148):
+        # load the exploration run's saved config and copy its env settings
+        ckpt_path = pathlib.Path(cfg.checkpoint.exploration_ckpt_path)
+        exploration_cfg = load_config_file(ckpt_path.parent.parent / "config.yaml")
+        if exploration_cfg.select("env.id") != cfg.select("env.id"):
+            raise ValueError(
+                "This experiment is run with a different environment from the one of "
+                f"the exploration you want to finetune. Got '{cfg.select('env.id')}', "
+                f"but the exploration used {exploration_cfg.select('env.id')}."
+            )
+        for k in (
+            "frame_stack",
+            "screen_size",
+            "action_repeat",
+            "grayscale",
+            "clip_rewards",
+            "frame_stack_dilation",
+            "max_episode_steps",
+            "reward_as_observation",
+        ):
+            if exploration_cfg.select(f"env.{k}") is not None:
+                cfg.set_path(f"env.{k}", exploration_cfg.select(f"env.{k}"))
+        kwargs["exploration_cfg"] = exploration_cfg
     dist = build_distributed(cfg)
     if cfg.select("metric.log_level", 1) == 0:
         from .utils.metric import MetricAggregator
@@ -100,7 +125,7 @@ def run_algorithm(cfg: Config) -> None:
         MetricAggregator.disabled = True
     if cfg.select("metric.disable_timer", False):
         timer.disabled = True
-    fn(dist, cfg)
+    fn(dist, cfg, **kwargs)
 
 
 def eval_algorithm(cfg: Config) -> None:

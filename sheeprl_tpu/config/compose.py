@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import os
 from pathlib import Path
-from typing import Any, List, Mapping, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import yaml
 
@@ -85,13 +85,14 @@ def _load_yaml(path: Path) -> Config:
     return Config(data)
 
 
-def _parse_default_entry(entry: Any) -> Tuple[Optional[str], Optional[str], bool, bool]:
-    """Return (group_path, option, is_self, optional) for a defaults-list entry."""
+def _parse_default_entry(entry: Any) -> Tuple[Optional[str], Optional[str], bool, bool, bool]:
+    """Return (group_path, option, is_self, optional, is_override) for a
+    defaults-list entry."""
     if entry == "_self_":
-        return None, None, True, False
+        return None, None, True, False, False
     if isinstance(entry, str):
         # bare "group/option" include
-        return entry, None, False, False
+        return entry, None, False, False, False
     if isinstance(entry, Mapping):
         if len(entry) != 1:
             raise ValueError(f"Malformed defaults entry: {entry}")
@@ -100,11 +101,40 @@ def _parse_default_entry(entry: Any) -> Tuple[Optional[str], Optional[str], bool
         if key.startswith("optional "):
             optional = True
             key = key[len("optional "):]
+        is_override = key.startswith("override ")
         key = key.removeprefix("override ")
         if isinstance(value, str) and value.endswith(".yaml"):
             value = value[: -len(".yaml")]
-        return key, value, False, optional
+        return key, value, False, optional, is_override
     raise ValueError(f"Malformed defaults entry: {entry}")
+
+
+def _collect_overrides(rel: str, roots: Sequence[Path], acc: Dict[str, str]) -> None:
+    """Walk an exp file's bare-include chain collecting `override /group:
+    option` entries (Hydra semantics: overrides rewrite the ROOT's group
+    choice so the group composes once, *before* any exp-level content — they
+    are not in-place merges). Outer files' overrides win over included ones."""
+    path = _find_config(rel, roots)
+    if path is None:
+        return
+    node = _load_yaml(path)
+    base_dir = rel.rsplit("/", 1)[0] if "/" in rel else ""
+    own: Dict[str, str] = {}
+    for entry in node.get("defaults", []) or []:
+        group, option, is_self, _, is_override = _parse_default_entry(entry)
+        if is_self or group is None:
+            continue
+        if is_override and option is not None:
+            plain = group.lstrip("/")
+            own[plain] = option
+        elif option is None:
+            # bare include (exp chaining) — inner overrides collected first
+            candidate = f"{base_dir}/{group}" if base_dir else group
+            if _find_config(candidate, roots) is not None:
+                _collect_overrides(candidate, roots, acc)
+            else:
+                _collect_overrides(group, roots, acc)
+    acc.update(own)
 
 
 def _compose_file(
@@ -133,12 +163,18 @@ def _compose_file(
     composed = Config()
     self_done = False
     for entry in defaults:
-        group, option, is_self, optional = _parse_default_entry(entry)
+        group, option, is_self, optional, is_override = _parse_default_entry(entry)
         if is_self:
             composed.merge(node)
             self_done = True
             continue
         assert group is not None
+        if is_override and used_choices is not None and option is not None:
+            # the override rewrote the root's group choice (consumed there) —
+            # nothing to merge at this position (Hydra semantics)
+            plain = group.lstrip("/")
+            if plain in used_choices:
+                continue
         # group may carry an @dest package: "env@env2: default"
         dest = None
         if "@" in group:
@@ -257,6 +293,13 @@ def compose(
     # are applied directly under their group key.
     choices = {g: o for g, o in group_sel if g != "exp"}
     exp_choice = dict(group_sel).get("exp")
+    if exp_choice:
+        # exp-file `override /group: option` entries rewrite the root's group
+        # choices (outermost exp wins; CLI wins over all)
+        exp_overrides: Dict[str, str] = {}
+        _collect_overrides(f"exp/{exp_choice}", roots, exp_overrides)
+        for g, o in exp_overrides.items():
+            choices.setdefault(g, o)
     used: set = set()
     cfg = _compose_file(config_name, roots, choices, used)
     if exp_choice:

@@ -1,3 +1,4 @@
+from .ensembles import build_ensembles  # noqa: F401
 from .models import (
     CNN,
     DeCNN,

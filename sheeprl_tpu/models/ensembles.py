@@ -1,0 +1,47 @@
+"""Vmapped MLP ensembles for Plan2Explore's disagreement signal.
+
+The reference builds `n` separate `MLP`s in a `nn.ModuleList` and loops over
+them per forward (p2e_dv1/agent.py:126-144, exploration train loops
+p2e_dv1_exploration.py:172-178, :208-217). On TPU a python loop over modules
+issues `n` small matmuls; here the member params are stacked on a leading
+axis and the forward is a single `jax.vmap` — XLA fuses it into batched
+matmuls on the MXU. Each member gets its own init key (the reference
+re-seeds per member with `cfg.seed + i`, p2e_dv1/agent.py:127-130).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .models import MLP
+
+
+def build_ensembles(
+    key: jax.Array,
+    n: int,
+    input_dim: int,
+    output_dim: int,
+    mlp_layers: int,
+    dense_units: int,
+    activation: str,
+) -> Tuple[Callable[[Any, jax.Array], jax.Array], Any]:
+    """Returns (apply, stacked_params).
+
+    `apply(params, x)` maps [..., input_dim] → [n, ..., output_dim]: every
+    ensemble member evaluated in one vmapped pass.
+    """
+    module = MLP(
+        output_dim=output_dim,
+        hidden_sizes=(dense_units,) * mlp_layers,
+        activation=activation,
+    )
+    dummy = jnp.zeros((1, input_dim), jnp.float32)
+    keys = jax.random.split(key, n)
+    params = jax.vmap(lambda k: module.init(k, dummy)["params"])(keys)
+
+    def apply(p: Any, x: jax.Array) -> jax.Array:
+        return jax.vmap(lambda member: module.apply({"params": member}, x))(p)
+
+    return apply, params

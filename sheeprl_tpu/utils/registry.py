@@ -14,11 +14,19 @@ algorithm_registry: Dict[str, Dict[str, Any]] = {}
 evaluation_registry: Dict[str, Dict[str, Any]] = {}
 
 
-def register_algorithm(name: Optional[str] = None, decoupled: bool = False) -> Callable:
+def register_algorithm(
+    name: Optional[str] = None,
+    decoupled: bool = False,
+    requires_exploration_cfg: bool = False,
+) -> Callable:
     """Register a training entrypoint ``main(cfg) -> None`` under ``name``.
 
     If ``name`` is omitted the function's module's last package name is used
     (e.g. ``sheeprl_tpu.algos.ppo.ppo`` registers as ``ppo``).
+    ``requires_exploration_cfg`` marks P2E-style finetuning entrypoints whose
+    signature takes the exploration run's saved config as a third argument —
+    the CLI performs the exploration→finetuning config surgery for these
+    (instead of the reference's name-substring heuristic, cli.py:117).
     """
 
     def wrap(fn: Callable) -> Callable:
@@ -31,6 +39,7 @@ def register_algorithm(name: Optional[str] = None, decoupled: bool = False) -> C
             "entrypoint": fn.__name__,
             "fn": fn,
             "decoupled": decoupled,
+            "requires_exploration_cfg": requires_exploration_cfg,
         }
         return fn
 

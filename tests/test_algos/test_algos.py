@@ -162,6 +162,143 @@ def test_dreamer_v1(standard_args, env_id):
     )
 
 
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_p2e_dv1(standard_args, env_id, tmp_path):
+    """Exploration then finetuning from its checkpoint (reference
+    test_algos.py:262-338)."""
+    import glob
+    import os
+
+    tiny = [
+        "env=dummy",
+        f"env.id={env_id}",
+        "algo.per_rank_batch_size=2",
+        "algo.per_rank_sequence_length=2",
+        "algo.learning_starts=0",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.ensembles.n=3",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=16",
+        "algo.world_model.representation_model.hidden_size=16",
+        "algo.world_model.transition_model.hidden_size=16",
+        "algo.world_model.stochastic_size=4",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.size=64",
+        "root_dir=p2e_test",
+        "run_name=expl",
+    ]
+    expl_args = [a for a in standard_args if "save_last" not in a] + [
+        "checkpoint.save_last=True",
+        "buffer.checkpoint=True",
+    ]
+    _run(["exp=p2e_dv1_exploration"] + tiny, expl_args)
+    ckpts = sorted(glob.glob(os.path.join("logs", "runs", "p2e_test", "expl", "*", "checkpoint", "*.ckpt")))
+    assert ckpts, "no exploration checkpoint written"
+    _run(
+        ["exp=p2e_dv1_finetuning", f"checkpoint.exploration_ckpt_path={ckpts[-1]}"] + tiny,
+        standard_args,
+    )
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_p2e_dv3(standard_args, env_id, tmp_path):
+    import glob
+    import os
+
+    tiny = [
+        "env=dummy",
+        f"env.id={env_id}",
+        "algo=p2e_dv3",
+        "algo.per_rank_batch_size=2",
+        "algo.per_rank_sequence_length=2",
+        "algo.learning_starts=0",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.ensembles.n=3",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=16",
+        "algo.world_model.representation_model.hidden_size=16",
+        "algo.world_model.transition_model.hidden_size=16",
+        "algo.world_model.discrete_size=4",
+        "algo.world_model.stochastic_size=4",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.size=64",
+        "root_dir=p2e_dv3_test",
+        "run_name=expl",
+    ]
+    expl_args = [a for a in standard_args if "save_last" not in a] + [
+        "checkpoint.save_last=True",
+        "buffer.checkpoint=True",
+    ]
+    _run(["exp=p2e_dv3_exploration", "algo.name=p2e_dv3_exploration"] + tiny, expl_args)
+    ckpts = sorted(
+        glob.glob(os.path.join("logs", "runs", "p2e_dv3_test", "expl", "*", "checkpoint", "*.ckpt"))
+    )
+    assert ckpts, "no exploration checkpoint written"
+    _run(
+        [
+            "exp=p2e_dv3_finetuning",
+            "algo.name=p2e_dv3_finetuning",
+            f"checkpoint.exploration_ckpt_path={ckpts[-1]}",
+        ]
+        + tiny,
+        standard_args,
+    )
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_p2e_dv2(standard_args, env_id, tmp_path):
+    import glob
+    import os
+
+    tiny = [
+        "env=dummy",
+        f"env.id={env_id}",
+        "algo=p2e_dv2",
+        "algo.per_rank_batch_size=2",
+        "algo.per_rank_sequence_length=2",
+        "algo.learning_starts=0",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.ensembles.n=3",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=16",
+        "algo.world_model.representation_model.hidden_size=16",
+        "algo.world_model.transition_model.hidden_size=16",
+        "algo.world_model.discrete_size=4",
+        "algo.world_model.stochastic_size=4",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.size=64",
+        "root_dir=p2e_dv2_test",
+        "run_name=expl",
+    ]
+    expl_args = [a for a in standard_args if "save_last" not in a] + [
+        "checkpoint.save_last=True",
+        "buffer.checkpoint=True",
+    ]
+    _run(["exp=p2e_dv2_exploration", "algo.name=p2e_dv2_exploration"] + tiny, expl_args)
+    ckpts = sorted(
+        glob.glob(os.path.join("logs", "runs", "p2e_dv2_test", "expl", "*", "checkpoint", "*.ckpt"))
+    )
+    assert ckpts, "no exploration checkpoint written"
+    _run(
+        [
+            "exp=p2e_dv2_finetuning",
+            "algo.name=p2e_dv2_finetuning",
+            f"checkpoint.exploration_ckpt_path={ckpts[-1]}",
+        ]
+        + tiny,
+        standard_args,
+    )
+
+
 def test_sac_ae(standard_args):
     _run(
         [
