@@ -208,7 +208,9 @@ class DV1WorldModel(nn.Module):
             min_std=self.min_std,
             dense_act=self.dense_act,
         )
-        cnn_encoder_output_dim = 8 * self.cnn_channels_multiplier * 2 * 2
+        from ..dreamer_v2.agent import cnn_encoder_output_dim as _enc_dim
+
+        cnn_encoder_output_dim = _enc_dim(self.cnn_channels_multiplier)
         self.observation_model = DV2Decoder(
             cnn_keys=self.cnn_keys,
             mlp_keys=self.mlp_keys,

@@ -37,6 +37,14 @@ from ...models import MLP, LayerNorm, LayerNormGRUCell
 from .utils import compute_stochastic_state
 
 
+def cnn_encoder_output_dim(channels_multiplier: int) -> int:
+    """Flat width of the DV1/DV2 CNN encoder output: 64×64 through 4 VALID
+    k4/s2 convs → 2×2 spatial with 8·m channels (reference dreamer_v2
+    CNNEncoder, agent.py:31-82). Shared by the decoders and the P2E ensemble
+    target sizing."""
+    return 8 * channels_multiplier * 2 * 2
+
+
 class DV2CNNEncoder(nn.Module):
     keys: Sequence[str]
     channels_multiplier: int
@@ -416,8 +424,7 @@ class DV2WorldModel(nn.Module):
             recurrent_layer_norm=self.recurrent_layer_norm,
             dense_act=self.dense_act,
         )
-        # encoder 64x64 VALID k4 s2 ×4 → 2×2 spatial, 8m channels
-        cnn_encoder_output_dim = 8 * self.cnn_channels_multiplier * 2 * 2
+        enc_out_dim = cnn_encoder_output_dim(self.cnn_channels_multiplier)
         self.observation_model = DV2Decoder(
             cnn_keys=self.cnn_keys,
             mlp_keys=self.mlp_keys,
@@ -425,7 +432,7 @@ class DV2WorldModel(nn.Module):
             mlp_output_dims=self.mlp_output_dims,
             cnn_channels_multiplier=self.decoder_cnn_channels_multiplier
             or self.cnn_channels_multiplier,
-            cnn_encoder_output_dim=cnn_encoder_output_dim,
+            cnn_encoder_output_dim=enc_out_dim,
             mlp_layers=self.decoder_mlp_layers or self.mlp_layers,
             dense_units=self.decoder_dense_units or self.dense_units,
             layer_norm=self.layer_norm,

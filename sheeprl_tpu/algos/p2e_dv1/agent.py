@@ -24,9 +24,11 @@ Actor = DV2Actor  # reference aliases (agent.py:22-23)
 def _embedded_obs_dim(cfg: Any, observation_space: gym.spaces.Dict) -> int:
     """Encoder output width: cnn flat dim + mlp dense_units (reference uses
     `encoder.cnn_output_dim + encoder.mlp_output_dim`, agent.py:135)."""
+    from ..dreamer_v2.agent import cnn_encoder_output_dim
+
     dim = 0
     if tuple(cfg.algo.cnn_keys.encoder):
-        dim += 8 * int(cfg.algo.world_model.encoder.cnn_channels_multiplier) * 2 * 2
+        dim += cnn_encoder_output_dim(int(cfg.algo.world_model.encoder.cnn_channels_multiplier))
     if tuple(cfg.algo.mlp_keys.encoder):
         dim += int(cfg.algo.world_model.encoder.dense_units)
     return dim

@@ -44,7 +44,7 @@ from ..dreamer_v1.agent import DV1WorldModel
 from ..dreamer_v1.loss import actor_loss, critic_loss, reconstruction_loss
 from ..dreamer_v1.utils import compute_lambda_values, normalize_obs, prepare_obs, test
 from ..dreamer_v2.agent import dv2_sample_actions
-from ..dreamer_v2.dreamer_v2 import make_player as make_dreamer_player
+from ..dreamer_v1.dreamer_v1 import make_player as make_dv1_player
 from .agent import build_agent
 
 AGGREGATOR_KEYS = {
@@ -396,9 +396,8 @@ def main(dist: Distributed, cfg: Config) -> None:
 
     train = make_train_fn(wm, actor, critic, ens_apply, txs, cfg, is_continuous, actions_dim)
     actor_type = str(cfg.algo.player.actor_type)
-    player_init, player_step_fn, expl_amount_at = make_dreamer_player(
-        wm, actor, cfg, actions_dim, is_continuous, num_envs,
-        stoch_width=int(cfg.algo.world_model.stochastic_size),
+    player_init, player_step_fn, expl_amount_at = make_dv1_player(
+        wm, actor, cfg, actions_dim, is_continuous, num_envs
     )
 
     aggregator = MetricAggregator(
@@ -543,10 +542,7 @@ def main(dist: Distributed, cfg: Config) -> None:
         # zero-shot: test the TASK actor (reference p2e_dv1_exploration.py:784)
         test_cfg = Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}})
         test_env = vectorize(test_cfg, cfg.seed, rank, log_dir).envs[0]
-        t_init, t_step, _ = make_dreamer_player(
-            wm, actor, cfg, actions_dim, is_continuous, 1,
-            stoch_width=int(cfg.algo.world_model.stochastic_size),
-        )
+        t_init, t_step, _ = make_dv1_player(wm, actor, cfg, actions_dim, is_continuous, 1)
         t_state = t_init()
 
         def _step(o, s, k, greedy):
@@ -606,10 +602,7 @@ def evaluate_p2e_dv1(dist: Distributed, cfg: Config, state: Dict[str, Any]) -> N
             "critic": p["critic_task"] if "critic_task" in p else p["critic"],
         },
     )
-    t_init, t_step, _ = make_dreamer_player(
-        wm, actor, cfg, actions_dim, is_continuous, 1,
-        stoch_width=int(cfg.algo.world_model.stochastic_size),
-    )
+    t_init, t_step, _ = make_dv1_player(wm, actor, cfg, actions_dim, is_continuous, 1)
     t_state = t_init()
 
     def _step(o, s, k, greedy):

@@ -1,1 +1,2 @@
 from . import ppo  # noqa: F401 — registers the algorithm + evaluation
+from . import ppo_decoupled  # noqa: F401

@@ -1,1 +1,2 @@
 from . import sac  # noqa: F401 — registers the algorithm + evaluation
+from . import sac_decoupled  # noqa: F401

@@ -1,0 +1,326 @@
+"""SAC decoupled — player/trainer split (Template C).
+
+Reference sheeprl/algos/sac/sac_decoupled.py (588 LoC): the rank-0 player
+owns the replay buffer, samples `G·B·(world-1)` transitions per iteration
+and scatters chunks to the DDP trainer group, which sends back flattened
+parameters (:230-265).
+
+TPU-native re-design (same shape as ppo_decoupled): a player thread owns the
+envs + replay buffer and the jitted act fn; the trainer main thread runs the
+scanned G-step SAC update over the device mesh. Per iteration with pending
+gradient steps they exchange (batch stack, params) through depth-1 queues —
+the queue handoff replaces the scatter_object_list/broadcast pair.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...config import Config, instantiate
+from ...data import ReplayBuffer
+from ...parallel import Distributed
+from ...utils.checkpoint import CheckpointManager
+from ...utils.env import episode_stats, vectorize
+from ...utils.logger import get_log_dir, get_logger
+from ...utils.metric import MetricAggregator
+from ...utils.registry import register_algorithm
+from ...utils.timer import timer
+from ...utils.utils import Ratio, save_configs
+from .agent import build_agent, sample_actions
+from .sac import make_train_fn
+from .utils import AGGREGATOR_KEYS, flatten_obs, test
+
+
+class _PlayerCrashed(Exception):
+    pass
+
+
+def _player_loop(
+    cfg: Config,
+    actor,
+    init_actor_params,
+    log_dir: str,
+    aggregator: MetricAggregator,
+    data_q: "queue.Queue",
+    params_q: "queue.Queue",
+    batch_size: int,
+    world_size: int,
+    state,
+    seed_key,
+) -> None:
+    """Env stepping + buffer ownership (reference player(), :53-338)."""
+    try:
+        envs = vectorize(cfg, cfg.seed, 0, log_dir)
+        action_space = envs.single_action_space
+        num_envs = int(cfg.env.num_envs)
+        mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+        act_dim = int(np.prod(action_space.shape))
+
+        @jax.jit
+        def act(actor_params, obs, key):
+            mean, log_std = actor.apply({"params": actor_params}, obs)
+            actions, _ = sample_actions(actor, mean, log_std, key)
+            return actions
+
+        buffer_size = int(cfg.buffer.size) if not cfg.dry_run else max(2 * num_envs, 8)
+        rb = ReplayBuffer(
+            buffer_size,
+            num_envs,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0")
+            if cfg.buffer.memmap
+            else None,
+        )
+        if state and cfg.buffer.checkpoint and "rb" in state:
+            rb.load_state_dict(state["rb"])
+
+        ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+        if state and "ratio" in state:
+            ratio.load_state_dict(state["ratio"])
+
+        actor_params = init_actor_params
+        root_key = seed_key
+        total_steps = int(cfg.algo.total_steps) if not cfg.dry_run else num_envs
+        learning_starts = int(cfg.algo.learning_starts) if not cfg.dry_run else 0
+        policy_step = state["policy_step"] if state else 0
+
+        obs, _ = envs.reset(seed=cfg.seed)
+        obs_vec = flatten_obs(obs, mlp_keys, num_envs)
+
+        while policy_step < total_steps:
+            with timer("Time/env_interaction_time"):
+                if policy_step <= learning_starts:
+                    env_actions = np.stack([action_space.sample() for _ in range(num_envs)])
+                else:
+                    root_key, k = jax.random.split(root_key)
+                    env_actions = np.asarray(
+                        act(actor_params, jnp.asarray(obs_vec), k)
+                    ).reshape(num_envs, act_dim)
+                next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
+                policy_step += num_envs
+
+                real_next = flatten_obs(next_obs, mlp_keys, num_envs).copy()
+                if "final_obs" in info:
+                    for i, fo in enumerate(info["final_obs"]):
+                        if fo is not None:
+                            real_next[i] = np.concatenate(
+                                [np.asarray(fo[k], np.float32).reshape(-1) for k in mlp_keys]
+                            )
+
+                step_data = {
+                    "observations": obs_vec.reshape(1, num_envs, -1),
+                    "next_observations": real_next.reshape(1, num_envs, -1),
+                    "actions": env_actions.reshape(1, num_envs, act_dim).astype(np.float32),
+                    "rewards": np.asarray(rewards, np.float32).reshape(1, num_envs, 1),
+                    "terminated": np.asarray(terminated, np.float32).reshape(1, num_envs, 1),
+                    "dones": np.logical_or(terminated, truncated)
+                    .astype(np.float32)
+                    .reshape(1, num_envs, 1),
+                }
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                obs_vec = flatten_obs(next_obs, mlp_keys, num_envs)
+
+                for ep_rew, ep_len in episode_stats(info):
+                    aggregator.update("Rewards/rew_avg", ep_rew)
+                    aggregator.update("Game/ep_len_avg", ep_len)
+
+            if policy_step >= learning_starts:
+                per_rank_gradient_steps = ratio(policy_step / world_size)
+                if per_rank_gradient_steps > 0:
+                    # sample once, stack [G, B, ...] (reference :243-258)
+                    sample = rb.sample(
+                        batch_size * per_rank_gradient_steps, sample_next_obs=False, n_samples=1
+                    )
+                    batches = {
+                        k: np.asarray(v).reshape(
+                            per_rank_gradient_steps, batch_size, *v.shape[2:]
+                        )
+                        for k, v in sample.items()
+                    }
+                    data_q.put(
+                        (policy_step, per_rank_gradient_steps, batches, ratio.state_dict(), rb)
+                    )
+                    actor_params = params_q.get()
+                    if actor_params is None:
+                        break
+
+        envs.close()
+        data_q.put(None)
+    except BaseException as e:
+        data_q.put(e)
+        raise
+
+
+@register_algorithm(name="sac_decoupled", decoupled=True)
+def main(dist: Distributed, cfg: Config) -> None:
+    root_key = dist.seed_everything(cfg.seed)
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
+    logger = get_logger(cfg, log_dir, 0)
+    save_configs(cfg, log_dir)
+
+    probe = vectorize(
+        Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}}), cfg.seed, 0, None
+    )
+    obs_space = probe.single_observation_space
+    action_space = probe.single_action_space
+    probe.close()
+    if not isinstance(action_space, gym.spaces.Box):
+        raise RuntimeError("SAC requires a continuous (Box) action space")
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        state = CheckpointManager.load(cfg.checkpoint.resume_from)
+    root_key, init_key, player_key = jax.random.split(state["rng"] if state else root_key, 3)
+    actor, critic, params = build_agent(
+        dist, cfg, obs_space, action_space, init_key, state["params"] if state else None
+    )
+    act_dim = int(np.prod(action_space.shape))
+    target_entropy = -act_dim
+
+    txs = {
+        "actor": instantiate(cfg.algo.actor.optimizer),
+        "critic": instantiate(cfg.algo.critic.optimizer),
+        "alpha": instantiate(cfg.algo.alpha.optimizer),
+    }
+    if state:
+        opt_states = state["opt_states"]
+    else:
+        opt_states = {
+            "actor": txs["actor"].init(params["actor"]),
+            "critic": txs["critic"].init(params["critic"]),
+            "alpha": txs["alpha"].init(params["log_alpha"]),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    train = make_train_fn(actor, critic, txs, cfg, target_entropy)
+    batch_size = int(cfg.algo.per_rank_batch_size) * dist.world_size
+
+    aggregator = MetricAggregator(
+        {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
+    )
+    ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=True)
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    cumulative_grad_steps = state["cumulative_grad_steps"] if state else 0
+
+    data_q: "queue.Queue" = queue.Queue(maxsize=1)
+    params_q: "queue.Queue" = queue.Queue(maxsize=1)
+    player = threading.Thread(
+        target=_player_loop,
+        name="sac-player",
+        args=(
+            cfg, actor, params["actor"], log_dir, aggregator, data_q, params_q,
+            batch_size, dist.world_size, state, player_key,
+        ),
+        daemon=True,
+    )
+    player.start()
+
+    policy_step = 0
+    rb = None
+    ratio_state = None
+    try:
+        while True:
+            item = data_q.get()
+            if item is None:
+                break
+            if isinstance(item, BaseException):
+                raise _PlayerCrashed("player thread crashed") from item
+            policy_step, G, batches, ratio_state, rb = item
+
+            with timer("Time/train_time"):
+                mb_sharding = dist.sharding(None, "dp")
+                device_batches = {
+                    k: jax.device_put(v, mb_sharding) for k, v in batches.items()
+                }
+                root_key, sub = jax.random.split(root_key)
+                keys = jax.random.split(sub, G)
+                params, opt_states, metrics = train(params, opt_states, device_batches, keys)
+                cumulative_grad_steps += G
+
+            # metrics / logging / checkpoint happen HERE, while the player is
+            # still blocked on params_q.get(): the shared aggregator/timer and
+            # the player-owned buffer are quiescent, so snapshots are
+            # consistent (no torn rb.state_dict, no racing timer.reset)
+            for k, v in metrics.items():
+                aggregator.update(k, np.asarray(v))
+
+            if logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
+                logger.log_metrics(aggregator.compute(), policy_step)
+                aggregator.reset()
+                timings = timer.compute()
+                if timings.get("Time/train_time"):
+                    logger.log_metrics(
+                        {"Time/sps_train": (policy_step - last_log) / timings["Time/train_time"]},
+                        policy_step,
+                    )
+                if policy_step > 0:
+                    logger.log_metrics(
+                        {"Params/replay_ratio": cumulative_grad_steps / policy_step}, policy_step
+                    )
+                timer.reset()
+                last_log = policy_step
+
+            if (
+                cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
+            ) or cfg.dry_run:
+                last_checkpoint = policy_step
+                ckpt_state = {
+                    "params": params,
+                    "opt_states": opt_states,
+                    "ratio": ratio_state,
+                    "policy_step": policy_step,
+                    "last_log": last_log,
+                    "last_checkpoint": last_checkpoint,
+                    "cumulative_grad_steps": cumulative_grad_steps,
+                    "rng": root_key,
+                }
+                if cfg.buffer.checkpoint and rb is not None:
+                    ckpt_state["rb"] = rb.state_dict()
+                ckpt.save(policy_step, ckpt_state)
+
+            params_q.put(params["actor"])
+    finally:
+        try:
+            params_q.put_nowait(None)
+        except queue.Full:
+            pass
+    player.join(timeout=60)
+
+    # final checkpoint (reference :322-338 on_checkpoint_player save_last)
+    if cfg.checkpoint.save_last:
+        ckpt_state = {
+            "params": params,
+            "opt_states": opt_states,
+            "ratio": ratio_state,
+            "policy_step": policy_step,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "cumulative_grad_steps": cumulative_grad_steps,
+            "rng": root_key,
+        }
+        if cfg.buffer.checkpoint and rb is not None:
+            ckpt_state["rb"] = rb.state_dict()
+        ckpt.save(policy_step, ckpt_state)
+
+    if cfg.algo.run_test:
+        test_env = vectorize(
+            Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}}),
+            cfg.seed,
+            0,
+            log_dir,
+        ).envs[0]
+        test(actor, params["actor"], test_env, cfg, log_dir, logger)
+    if not cfg.model_manager.disabled:
+        from ...utils.model_manager import register_model
+
+        register_model(cfg, {"actor": params["actor"], "critic": params["critic"]}, log_dir)
+    if logger is not None:
+        logger.close()

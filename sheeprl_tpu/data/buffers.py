@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from .. import native as _native
 from .memmap import MemmapArray
 
 if typing.TYPE_CHECKING:
@@ -276,9 +277,26 @@ class SequentialReplayBuffer(ReplayBuffer):
             starts = np.random.randint(0, self._pos - L + 1, size=total)
         env_idxs = np.random.randint(0, self._n_envs, size=total)
         seq = (starts[:, None] + np.arange(L)[None, :]) % self._buffer_size  # [total, L]
+        # flat (time, env) row indices in FINAL [n_samples, L, batch] order —
+        # the native gather writes the training layout directly, skipping the
+        # numpy path's intermediate [total, L, ...] + transpose copy
+        flat_rows = np.ascontiguousarray(
+            (seq * self._n_envs + env_idxs[:, None])
+            .reshape(n_samples, batch_size, L)
+            .transpose(0, 2, 1),
+            dtype=np.int64,
+        )
         out: Dict[str, np.ndarray] = {}
         for k, v in self._buf.items():
             arr = np.asarray(v)
+            item_shape = arr.shape[2:]
+            out_shape = (n_samples, L, batch_size, *item_shape)
+            gathered = _native.gather_rows(
+                arr.reshape(self._buffer_size * self._n_envs, *item_shape), flat_rows, out_shape
+            )
+            if gathered is not None:
+                out[k] = gathered
+                continue
             taken = arr[seq, env_idxs[:, None]]  # [total, L, ...]
             taken = taken.reshape(n_samples, batch_size, L, *arr.shape[2:])
             taken = np.swapaxes(taken, 1, 2)  # → [n_samples, L, batch, ...]

@@ -1,0 +1,125 @@
+"""Native (C++) host-side kernels, built on demand and bound via ctypes.
+
+The toolchain ships g++ but no pybind11, so the binding is a plain C ABI +
+ctypes (see replay_gather.cpp for the kernels and why they exist). The
+shared object is compiled lazily on first use into the package directory
+(falling back to a temp dir if read-only) and cached; every consumer must
+handle `load_native() is None` and keep a pure-numpy fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_SRC = Path(__file__).resolve().parent / "replay_gather.cpp"
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_N_THREADS = int(os.environ.get("SHEEPRL_TPU_NATIVE_THREADS", "4"))
+
+
+def _build(so_path: Path) -> bool:
+    cmd = [
+        "g++",
+        "-O3",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        "-pthread",
+        str(_SRC),
+        "-o",
+        str(so_path),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the native library; None if unavailable."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("SHEEPRL_TPU_DISABLE_NATIVE"):
+            return None
+        candidates = [
+            Path(__file__).resolve().parent / "_replay_gather.so",
+            Path(tempfile.gettempdir()) / f"sheeprl_tpu_replay_gather_{os.getuid()}.so",
+        ]
+        for so_path in candidates:
+            if not so_path.is_file() or so_path.stat().st_mtime < _SRC.stat().st_mtime:
+                try:
+                    so_path.parent.mkdir(parents=True, exist_ok=True)
+                    if not _build(so_path):
+                        continue
+                except OSError:
+                    continue
+            try:
+                lib = ctypes.CDLL(str(so_path))
+                lib.gather_rows.argtypes = [
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                    ctypes.c_void_p,
+                    ctypes.c_int32,
+                ]
+                lib.gather_rows.restype = None
+                lib.circular_add.argtypes = [
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                    ctypes.c_int64,
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                    ctypes.c_int64,
+                ]
+                lib.circular_add.restype = None
+                _LIB = lib
+                return _LIB
+            except OSError:
+                continue
+        return None
+
+
+def gather_rows(src: np.ndarray, row_idx: np.ndarray, out_shape) -> Optional[np.ndarray]:
+    """Gather rows of a C-contiguous array by flat leading-axis index.
+
+    `src` is treated as [R, F] with R = src.shape[0] (callers pre-flatten);
+    `row_idx` (any shape, int64) selects rows in destination order. Returns
+    the gathered array reshaped to `out_shape`, or None if the native path
+    cannot handle the input (caller falls back to numpy)."""
+    lib = load_native()
+    if lib is None:
+        return None
+    src = np.asarray(src)
+    if not src.flags["C_CONTIGUOUS"] or src.dtype.hasobject:
+        return None
+    idx = np.ascontiguousarray(row_idx, dtype=np.int64)
+    n_out = idx.size
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    if row_bytes == 0:
+        return np.empty(out_shape, dtype=src.dtype)
+    out = np.empty((n_out,) + src.shape[1:], dtype=src.dtype)
+    lib.gather_rows(
+        src.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(row_bytes),
+        idx.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n_out),
+        out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int32(_N_THREADS),
+    )
+    return out.reshape(out_shape)

@@ -1,0 +1,81 @@
+// Native replay-buffer gather kernels.
+//
+// The reference framework's only "native" layer is what torch/NCCL provide
+// underneath (SURVEY.md §2); its replay sampling is numpy fancy-indexing
+// (sheeprl/data/buffers.py:462-526).  For the TPU build the replay stream is
+// the host-side hot path feeding HBM (SURVEY.md §7 stage-2 requirement), so
+// the inner gather — thousands of strided row copies per gradient step — is
+// implemented here as a multithreaded memcpy kernel and bound via ctypes
+// (no pybind11 in the image).
+//
+// Layout contract: `src` is a C-contiguous [R, F] byte matrix (R = rows =
+// buffer_size * n_envs, F = row bytes); `row_idx` holds N row indices in
+// *destination* order, so dst is written once, contiguously, already in the
+// [n_samples, seq_len, batch, ...] layout the training step wants (the numpy
+// path needs an extra transpose+copy to get there).
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Copy rows src[row_idx[i]] -> dst[i] for i in [0, n_out).
+void gather_rows(const char* src,
+                 int64_t row_bytes,
+                 const int64_t* row_idx,
+                 int64_t n_out,
+                 char* dst,
+                 int32_t n_threads) {
+  if (n_out <= 0 || row_bytes <= 0) return;
+  const int64_t total_bytes = n_out * row_bytes;
+  // Small gathers: threading overhead dominates.
+  int32_t workers = n_threads;
+  if (workers <= 0) workers = 1;
+  if (total_bytes < (1 << 20)) workers = 1;
+  workers = std::min<int64_t>(workers, n_out);
+
+  auto copy_range = [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      std::memcpy(dst + i * row_bytes, src + row_idx[i] * row_bytes,
+                  static_cast<size_t>(row_bytes));
+    }
+  };
+
+  if (workers == 1) {
+    copy_range(0, n_out);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(workers);
+  const int64_t chunk = (n_out + workers - 1) / workers;
+  for (int32_t t = 0; t < workers; ++t) {
+    const int64_t begin = t * chunk;
+    const int64_t end = std::min<int64_t>(begin + chunk, n_out);
+    if (begin >= end) break;
+    threads.emplace_back(copy_range, begin, end);
+  }
+  for (auto& th : threads) th.join();
+}
+
+// Circular add: copy `n_rows` rows of data into dst starting at ring
+// position `pos` (dst has `capacity` rows), wrapping once if needed
+// (reference buffers.py:194-198 wrap-around idx math).
+void circular_add(char* dst,
+                  int64_t capacity,
+                  int64_t row_bytes,
+                  const char* data,
+                  int64_t n_rows,
+                  int64_t pos) {
+  if (n_rows <= 0) return;
+  const int64_t first = std::min(n_rows, capacity - pos);
+  std::memcpy(dst + pos * row_bytes, data, static_cast<size_t>(first * row_bytes));
+  if (first < n_rows) {
+    std::memcpy(dst, data + first * row_bytes,
+                static_cast<size_t>((n_rows - first) * row_bytes));
+  }
+}
+
+}  // extern "C"

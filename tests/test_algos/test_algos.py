@@ -299,6 +299,42 @@ def test_p2e_dv2(standard_args, env_id, tmp_path):
     )
 
 
+def test_ppo_decoupled(standard_args):
+    common = [
+        "exp=ppo_decoupled",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=4",
+        "algo.update_epochs=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+    ]
+    # a decoupled run needs at least a player and a trainer device
+    # (reference test_algos.py:126-144 asserts the same failure)
+    with pytest.raises(RuntimeError):
+        _run(common + ["fabric.devices=1"], standard_args)
+    _run(common + ["fabric.devices=2"], standard_args)
+
+
+def test_sac_decoupled(standard_args):
+    common = [
+        "exp=sac_decoupled",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "algo.per_rank_batch_size=4",
+        "algo.hidden_size=8",
+        "algo.learning_starts=0",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.size=64",
+    ]
+    with pytest.raises(RuntimeError):
+        _run(common + ["fabric.devices=1"], standard_args)
+    _run(common + ["fabric.devices=2"], standard_args)
+
+
 def test_sac_ae(standard_args):
     _run(
         [
