@@ -1,0 +1,72 @@
+"""Crafter adapter (reference sheeprl/envs/crafter.py, 67 LoC): Dict 'rgb'
+observation; done splits into terminated (discount 0) vs truncated."""
+from __future__ import annotations
+
+from ..utils.imports import _IS_CRAFTER_AVAILABLE
+
+if not _IS_CRAFTER_AVAILABLE:
+    raise ModuleNotFoundError(str(_IS_CRAFTER_AVAILABLE))
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+import crafter
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+
+class CrafterWrapper(gym.Wrapper):
+    def __init__(self, id: str, screen_size: Union[Tuple[int, int], int], seed: Optional[int] = None) -> None:
+        assert id in {"crafter_reward", "crafter_nonreward"}
+        if isinstance(screen_size, int):
+            screen_size = (screen_size,) * 2
+        env = crafter.Env(size=screen_size, seed=seed, reward=(id == "crafter_reward"))
+        super().__init__(env)
+        self.observation_space = spaces.Dict(
+            {
+                "rgb": spaces.Box(
+                    self.env.observation_space.low,
+                    self.env.observation_space.high,
+                    self.env.observation_space.shape,
+                    self.env.observation_space.dtype,
+                )
+            }
+        )
+        self.action_space = spaces.Discrete(self.env.action_space.n)
+        self.reward_range = self.env.reward_range or (-np.inf, np.inf)
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+        self._render_mode = "rgb_array"
+        self._metadata = {"render_fps": 30}
+
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    def _convert_obs(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
+        return {"rgb": obs}
+
+    def step(self, action: Any):
+        obs, reward, done, info = self.env.step(action)
+        return (
+            self._convert_obs(obs),
+            reward,
+            done and info["discount"] == 0,
+            done and info["discount"] != 0,
+            info,
+        )
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        # the reference assigns unconditionally (crafter.py:58), wiping the
+        # constructor seed on every autoreset so all vector envs replay
+        # identical worlds — only override when a seed is actually given
+        if seed is not None:
+            self.env._seed = seed
+        obs = self.env.reset()
+        return self._convert_obs(obs), {}
+
+    def render(self):
+        return self.env.render()
+
+    def close(self) -> None:
+        return

@@ -1,0 +1,200 @@
+"""DeepMind Control Suite adapter (reference sheeprl/envs/dmc.py, 268 LoC,
+itself adapted from denisyarats/dmc2gym).
+
+Behavioral parity: actions normalized to [-1, 1] and rescaled to the task's
+true bounds; observation is a Dict with 'rgb' (rendered pixels) and/or
+'state' (flattened vector obs); `truncated` when the time-limit fires with
+discount 1, `terminated` when discount hits 0.
+
+Divergence: images default to **channel-last** (the TPU conv layout) —
+`channels_first=False` — where the torch reference defaults to CHW.
+"""
+from __future__ import annotations
+
+from ..utils.imports import _IS_DMC_AVAILABLE
+
+if not _IS_DMC_AVAILABLE:
+    raise ModuleNotFoundError(str(_IS_DMC_AVAILABLE))
+
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+from dm_control import suite
+from dm_env import specs
+from gymnasium import spaces
+
+
+def _spec_to_box(spec, dtype) -> spaces.Box:
+    def extract_min_max(s):
+        assert s.dtype == np.float64 or s.dtype == np.float32
+        dim = int(np.prod(s.shape))
+        if type(s) == specs.Array:
+            bound = np.inf * np.ones(dim, dtype=np.float32)
+            return -bound, bound
+        elif type(s) == specs.BoundedArray:
+            zeros = np.zeros(dim, dtype=np.float32)
+            return s.minimum + zeros, s.maximum + zeros
+        raise ValueError(f"Unrecognized spec: {type(s)}")
+
+    mins, maxs = [], []
+    for s in spec:
+        mn, mx = extract_min_max(s)
+        mins.append(mn)
+        maxs.append(mx)
+    low = np.concatenate(mins, axis=0).astype(dtype)
+    high = np.concatenate(maxs, axis=0).astype(dtype)
+    return spaces.Box(low, high, dtype=dtype)
+
+
+def _flatten_obs(obs: Dict[Any, Any]) -> np.ndarray:
+    pieces = []
+    for v in obs.values():
+        pieces.append(np.array([v]) if np.isscalar(v) else np.asarray(v).ravel())
+    return np.concatenate(pieces, axis=0)
+
+
+class DMCWrapper(gym.Env):
+    """dm_control task → gymnasium Dict-obs env (reference dmc.py:49-268;
+    the reference subclasses gym.Wrapper, but modern gymnasium requires the
+    wrapped core to be a gymnasium.Env, so this holds the dm_env directly)."""
+
+    def __init__(
+        self,
+        domain_name: str,
+        task_name: str,
+        from_pixels: bool = False,
+        from_vectors: bool = True,
+        height: int = 84,
+        width: int = 84,
+        camera_id: int = 0,
+        task_kwargs: Optional[Dict[Any, Any]] = None,
+        environment_kwargs: Optional[Dict[Any, Any]] = None,
+        channels_first: bool = False,
+        visualize_reward: bool = False,
+        seed: Optional[int] = None,
+    ):
+        if not (from_vectors or from_pixels):
+            raise ValueError(
+                "'from_vectors' and 'from_pixels' must not be both False: "
+                f"got {from_vectors} and {from_pixels} respectively."
+            )
+        self._from_pixels = from_pixels
+        self._from_vectors = from_vectors
+        self._height = height
+        self._width = width
+        self._camera_id = camera_id
+        self._channels_first = channels_first
+
+        task_kwargs = dict(task_kwargs or {})
+        # the reference pops `random` and never seeds the task (dmc.py:126);
+        # thread the constructor seed through for reproducible dynamics
+        task_kwargs.pop("random", None)
+        if seed is not None:
+            task_kwargs["random"] = seed
+        self.env = suite.load(
+            domain_name=domain_name,
+            task_name=task_name,
+            task_kwargs=task_kwargs,
+            visualize_reward=visualize_reward,
+            environment_kwargs=environment_kwargs,
+        )
+
+        self._true_action_space = _spec_to_box([self.env.action_spec()], np.float32)
+        self._norm_action_space = spaces.Box(
+            low=-1.0, high=1.0, shape=self._true_action_space.shape, dtype=np.float32
+        )
+        reward_space = _spec_to_box([self.env.reward_spec()], np.float32)
+        self._reward_range = (reward_space.low.item(), reward_space.high.item())
+
+        obs_space: Dict[str, gym.Space] = {}
+        if from_pixels:
+            shape = (3, height, width) if channels_first else (height, width, 3)
+            obs_space["rgb"] = spaces.Box(low=0, high=255, shape=shape, dtype=np.uint8)
+        if from_vectors:
+            obs_space["state"] = _spec_to_box(self.env.observation_spec().values(), np.float64)
+        self._observation_space = spaces.Dict(obs_space)
+        self._state_space = _spec_to_box(self.env.observation_spec().values(), np.float64)
+        self.current_state = None
+        self._render_mode = "rgb_array"
+        self._metadata = {"render_fps": 30}
+        self.seed(seed=seed)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self.env, name)
+
+    def _get_obs(self, time_step) -> Dict[str, np.ndarray]:
+        obs: Dict[str, np.ndarray] = {}
+        if self._from_pixels:
+            rgb = self.render(camera_id=self._camera_id)
+            if self._channels_first:
+                rgb = rgb.transpose(2, 0, 1).copy()
+            obs["rgb"] = rgb
+        if self._from_vectors:
+            obs["state"] = _flatten_obs(time_step.observation)
+        return obs
+
+    def _convert_action(self, action) -> np.ndarray:
+        action = np.asarray(action, np.float64)
+        true_delta = self._true_action_space.high - self._true_action_space.low
+        norm_delta = self._norm_action_space.high - self._norm_action_space.low
+        action = (action - self._norm_action_space.low) / norm_delta
+        return (action * true_delta + self._true_action_space.low).astype(np.float32)
+
+    @property
+    def observation_space(self):
+        return self._observation_space
+
+    @property
+    def state_space(self) -> spaces.Box:
+        return self._state_space
+
+    @property
+    def action_space(self) -> spaces.Box:
+        return self._norm_action_space
+
+    @property
+    def reward_range(self) -> Tuple[float, float]:
+        return self._reward_range
+
+    @property
+    def render_mode(self) -> str:
+        return self._render_mode
+
+    def seed(self, seed: Optional[int] = None):
+        self._true_action_space.seed(seed)
+        self._norm_action_space.seed(seed)
+        self._observation_space.seed(seed)
+
+    def step(self, action):
+        action = self._convert_action(action)
+        time_step = self.env.step(action)
+        reward = time_step.reward or 0.0
+        obs = self._get_obs(time_step)
+        self.current_state = _flatten_obs(time_step.observation)
+        extra = {
+            "discount": time_step.discount,
+            "internal_state": self.env.physics.get_state().copy(),
+        }
+        truncated = time_step.last() and time_step.discount == 1
+        terminated = (
+            False if time_step.first() else bool(time_step.last() and time_step.discount == 0)
+        )
+        return obs, reward, terminated, truncated, extra
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        if seed is not None:
+            try:
+                self.env.task._random = np.random.RandomState(seed)
+            except AttributeError:
+                pass
+        time_step = self.env.reset()
+        self.current_state = _flatten_obs(time_step.observation)
+        return self._get_obs(time_step), {}
+
+    def render(self, camera_id: Optional[int] = None):
+        return self.env.physics.render(
+            height=self._height, width=self._width, camera_id=camera_id or self._camera_id
+        )

@@ -1,0 +1,219 @@
+"""MineRL adapter (reference sheeprl/envs/minerl.py, 319 LoC + custom task
+specs in sheeprl/envs/minerl_envs/, 465 LoC).
+
+Implements the reference wrapper contract: a flat Discrete action space built
+by enumerating the MineRL dict action space (camera binned to ±15° pitch/yaw
+moves, jump/sneak/sprint fused with forward, Enum actions expanded per
+value), sticky attack/jump counters, pitch limits, and the observation dict
+{rgb, life_stats, inventory, max_inventory[, compass][, equipment]} with
+optional multihot item encoding.
+
+Divergence (documented): the reference registers customized Navigate/Obtain
+task specs with adjustable `break_speed` (minerl_envs/, reference
+minerl.py:43-46); here tasks are resolved through `minerl`'s standard
+registry via `gym.make(id)`. The `break_speed_multiplier` still controls the
+sticky-attack heuristic. MineRL 0.4.4 predates gymnasium and modern Python;
+this adapter is untested against live Malmo instances.
+"""
+from __future__ import annotations
+
+from ..utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError(str(_IS_MINERL_AVAILABLE))
+
+import copy
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import minerl  # noqa: F401
+import numpy as np
+from minerl.herobraine.hero import mc
+
+N_ALL_ITEMS = len(mc.ALL_ITEMS)
+ITEM_NAME_TO_ID = dict(zip(mc.ALL_ITEMS, range(N_ALL_ITEMS)))
+
+
+class MineRLWrapper(gym.Wrapper):
+    def __init__(
+        self,
+        id: str,
+        height: int = 64,
+        width: int = 64,
+        pitch_limits: Tuple[int, int] = (-60, 60),
+        seed: Optional[int] = None,
+        sticky_attack: Optional[int] = 30,
+        sticky_jump: Optional[int] = 10,
+        break_speed_multiplier: Optional[int] = 100,
+        multihot_inventory: bool = True,
+        **kwargs: Any,
+    ):
+        import gym as legacy_gym
+
+        self._height = height
+        self._width = width
+        self._pitch_limits = pitch_limits
+        self._sticky_attack = 0 if break_speed_multiplier > 1 else sticky_attack
+        self._sticky_jump = sticky_jump
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+        self._break_speed_multiplier = break_speed_multiplier
+        self._multihot_inventory = multihot_inventory
+        env = legacy_gym.make(id)
+        super().__init__(env)
+
+        # flat Discrete action space over the MineRL dict space
+        # (reference minerl.py:100-141)
+        import minerl.herobraine.hero.spaces as hero_spaces
+
+        self.ACTIONS_MAP: Dict[int, Dict[str, Any]] = {0: {}}
+        act_idx = 1
+        for act in self.env.action_space:
+            space = self.env.action_space[act]
+            if isinstance(space, hero_spaces.Enum):
+                act_val = sorted(set(space.values.tolist()) - {"none"})
+            elif act != "camera":
+                act_val = [1]
+            else:
+                act_val = [
+                    np.array([-15, 0]),
+                    np.array([15, 0]),
+                    np.array([0, -15]),
+                    np.array([0, 15]),
+                ]
+            mapped = {act_idx + i: {act: v} for i, v in enumerate(act_val)}
+            if act in {"jump", "sneak", "sprint"}:
+                mapped[act_idx]["forward"] = 1
+            self.ACTIONS_MAP.update(mapped)
+            act_idx += len(act_val)
+        self.action_space = gym.spaces.Discrete(len(self.ACTIONS_MAP))
+
+        inv_dim = (
+            N_ALL_ITEMS
+            if multihot_inventory
+            else len(self.env.observation_space["inventory"].spaces)
+        )
+        obs_space: Dict[str, gym.Space] = {
+            "rgb": gym.spaces.Box(0, 255, (height, width, 3), np.uint8),
+            "life_stats": gym.spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
+            "inventory": gym.spaces.Box(0.0, np.inf, (inv_dim,), np.float32),
+            "max_inventory": gym.spaces.Box(0.0, np.inf, (inv_dim,), np.float32),
+        }
+        if "compass" in self.env.observation_space.spaces:
+            obs_space["compass"] = gym.spaces.Box(-180.0, 180.0, (1,), np.float32)
+        if "equipped_items" in self.env.observation_space.spaces:
+            obs_space["equipment"] = gym.spaces.Box(0.0, 1.0, (inv_dim,), np.int32)
+        self.observation_space = gym.spaces.Dict(obs_space)
+        self._inventory_names = (
+            None
+            if multihot_inventory
+            else sorted(self.env.observation_space["inventory"].spaces.keys())
+        )
+        self._max_inventory = np.zeros(inv_dim, np.float32)
+        self._pos = {"pitch": 0.0, "yaw": 0.0}
+        self._render_mode = "rgb_array"
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    def __getattr__(self, name):
+        return getattr(self.env, name)
+
+    def _item_index(self, name: str) -> Optional[int]:
+        if self._multihot_inventory:
+            return ITEM_NAME_TO_ID.get("_".join(name.split(" ")))
+        try:
+            return self._inventory_names.index(name)
+        except ValueError:
+            return None
+
+    def _convert_inventory(self, inventory: Dict[str, Any]) -> np.ndarray:
+        dim = self.observation_space["inventory"].shape[0]
+        counts = np.zeros(dim, np.float32)
+        for item, quantity in inventory.items():
+            idx = self._item_index(item)
+            if idx is not None:
+                counts[idx] += float(np.asarray(quantity).sum())
+        self._max_inventory = np.maximum(counts, self._max_inventory)
+        return counts
+
+    def _convert_action(self, action: int) -> Dict[str, Any]:
+        chosen = self.ACTIONS_MAP[int(np.asarray(action).squeeze())]
+        converted = self.env.action_space.noop()
+        for k, v in chosen.items():
+            converted[k] = v
+        # sticky attack / jump (reference minerl.py:214-239)
+        if self._sticky_attack:
+            if converted.get("attack", 0):
+                self._sticky_attack_counter = self._sticky_attack - 1
+            elif self._sticky_attack_counter > 0:
+                converted["attack"] = 1
+                self._sticky_attack_counter -= 1
+        if self._sticky_jump:
+            if converted.get("jump", 0):
+                self._sticky_jump_counter = self._sticky_jump - 1
+            elif self._sticky_jump_counter > 0:
+                converted["jump"] = 1
+                if not converted.get("forward", 0) and not converted.get("back", 0):
+                    converted["forward"] = 1
+                self._sticky_jump_counter -= 1
+        # pitch clamp
+        cam = np.asarray(converted.get("camera", np.zeros(2)), np.float32)
+        next_pitch = self._pos["pitch"] + cam[0]
+        if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
+            cam[0] = 0.0
+            converted["camera"] = cam
+        return converted
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {"rgb": np.asarray(obs["pov"], np.uint8)}
+        life = obs.get("life_stats", {})
+        out["life_stats"] = np.array(
+            [
+                float(np.asarray(life.get("life", 20.0)).item()),
+                float(np.asarray(life.get("food", 20.0)).item()),
+                float(np.asarray(life.get("air", 300.0)).item()),
+            ],
+            np.float32,
+        )
+        out["inventory"] = self._convert_inventory(obs.get("inventory", {}))
+        out["max_inventory"] = self._max_inventory.copy()
+        if "compass" in self.observation_space.spaces:
+            out["compass"] = np.asarray(
+                [np.asarray(obs["compass"]["angle"]).item()], np.float32
+            )
+        if "equipment" in self.observation_space.spaces:
+            equip = np.zeros(self.observation_space["equipment"].shape[0], np.int32)
+            eq = obs.get("equipped_items", {}).get("mainhand", {})
+            idx = self._item_index(str(eq.get("type", "air")))
+            if idx is not None:
+                equip[idx] = 1
+            out["equipment"] = equip
+        return out
+
+    def step(self, action):
+        converted = self._convert_action(action)
+        obs, reward, done, info = self.env.step(converted)
+        cam = np.asarray(converted.get("camera", np.zeros(2)), np.float32)
+        self._pos["pitch"] = float(self._pos["pitch"] + cam[0])
+        self._pos["yaw"] = float(self._pos["yaw"] + cam[1])
+        is_timelimit = bool(info.get("TimeLimit.truncated", False))
+        return self._convert_obs(obs), reward, done and not is_timelimit, done and is_timelimit, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs = self.env.reset()
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+        self._max_inventory[:] = 0
+        self._pos = {"pitch": 0.0, "yaw": 0.0}
+        return self._convert_obs(obs), {}
+
+    def render(self):
+        prev = getattr(self.env.unwrapped, "_last_pov", None)
+        return prev
+
+    def close(self):
+        return self.env.close()

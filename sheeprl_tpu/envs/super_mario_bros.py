@@ -1,0 +1,71 @@
+"""Super Mario Bros adapter (reference sheeprl/envs/super_mario_bros.py,
+96 LoC): JoypadSpace action mapping, Dict 'rgb' observation, time-limit done
+reported as truncation."""
+from __future__ import annotations
+
+from ..utils.imports import _IS_SUPER_MARIO_BROS_AVAILABLE
+
+if not _IS_SUPER_MARIO_BROS_AVAILABLE:
+    raise ModuleNotFoundError(str(_IS_SUPER_MARIO_BROS_AVAILABLE))
+
+from typing import Any, Dict, Optional
+
+import gym_super_mario_bros as gsmb
+import gymnasium as gym
+import numpy as np
+from gym_super_mario_bros.actions import COMPLEX_MOVEMENT, RIGHT_ONLY, SIMPLE_MOVEMENT
+from nes_py.wrappers import JoypadSpace
+
+ACTIONS_SPACE_MAP = {"simple": SIMPLE_MOVEMENT, "right_only": RIGHT_ONLY, "complex": COMPLEX_MOVEMENT}
+
+
+class JoypadSpaceCustomReset(JoypadSpace):
+    def reset(self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        return self.env.reset(seed=seed, options=options)
+
+
+class SuperMarioBrosWrapper(gym.Wrapper):
+    def __init__(self, id: str, action_space: str = "simple", render_mode: str = "rgb_array"):
+        env = gsmb.make(id)
+        env = JoypadSpaceCustomReset(env, ACTIONS_SPACE_MAP[action_space])
+        super().__init__(env)
+        self._render_mode = render_mode
+        self.observation_space = gym.spaces.Dict(
+            {
+                "rgb": gym.spaces.Box(
+                    env.observation_space.low,
+                    env.observation_space.high,
+                    env.observation_space.shape,
+                    env.observation_space.dtype,
+                )
+            }
+        )
+        self.action_space = gym.spaces.Discrete(env.action_space.n)
+
+    @property
+    def render_mode(self) -> str:
+        return self._render_mode
+
+    @render_mode.setter
+    def render_mode(self, render_mode: str):
+        self._render_mode = render_mode
+
+    def step(self, action):
+        if isinstance(action, np.ndarray):
+            action = action.squeeze().item()
+        obs, reward, done, info = self.env.step(action)
+        # parity with reference super_mario_bros.py:59-60: info["time"] is the
+        # remaining game clock, so any done with time left registers as a
+        # truncation; only timer expiry (time == 0) terminates
+        is_timelimit = info.get("time", False)
+        return {"rgb": obs.copy()}, reward, done and not is_timelimit, done and is_timelimit, info
+
+    def render(self):
+        frame = self.env.render(mode=self.render_mode)
+        if self.render_mode == "rgb_array" and frame is not None:
+            return frame.copy()
+        return None
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs = self.env.reset(seed=seed, options=options)
+        return {"rgb": obs.copy()}, {}

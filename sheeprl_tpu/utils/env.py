@@ -136,7 +136,14 @@ def make_env(
             instantiate_kwargs["rank"] = rank + vector_env_idx
         env = instantiate(wrapper_cfg, **instantiate_kwargs)
 
-        if cfg.env.get("action_repeat", 1) > 1:
+        # atari (frameskip in ALE) and DIAMBRA (engine-side repeat_action)
+        # repeat internally — don't double-apply (reference env.py:76-81)
+        env_target = str(wrapper_cfg.get("_target_", "")).lower()
+        if (
+            cfg.env.get("action_repeat", 1) > 1
+            and "atari" not in str(cfg.env.get("id", "")).lower()
+            and "diambra" not in env_target
+        ):
             env = ActionRepeat(env, cfg.env.action_repeat)
         if cfg.env.get("mask_velocities", False):
             env = MaskVelocityWrapper(env)
