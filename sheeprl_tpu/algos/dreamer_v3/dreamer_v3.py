@@ -60,6 +60,24 @@ from .utils import (
 )
 
 
+def build_optimizers(cfg: Config, params):
+    """Clipped wm/actor/critic optax transforms + fresh opt states (shared by
+    the train loop, bench_dv3.py and __graft_entry__.py so the measured
+    program is exactly the training program)."""
+    txs = {
+        "wm": clipped(instantiate(cfg.algo.world_model.optimizer), cfg.algo.world_model.clip_gradients),
+        "actor": clipped(instantiate(cfg.algo.actor.optimizer), cfg.algo.actor.clip_gradients),
+        "critic": clipped(instantiate(cfg.algo.critic.optimizer), cfg.algo.critic.clip_gradients),
+    }
+    opt_states = {
+        "wm": txs["wm"].init(params["wm"]),
+        "actor": txs["actor"].init(params["actor"]),
+        "critic": txs["critic"].init(params["critic"]),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    return txs, opt_states
+
+
 def make_train_fn(
     wm: WorldModel,
     actor: Actor,
@@ -382,21 +400,11 @@ def main(dist: Distributed, cfg: Config) -> None:
         dist, cfg, obs_space, actions_dim, is_continuous, init_key, state["params"] if state else None
     )
 
-    txs = {
-        "wm": clipped(instantiate(cfg.algo.world_model.optimizer), cfg.algo.world_model.clip_gradients),
-        "actor": clipped(instantiate(cfg.algo.actor.optimizer), cfg.algo.actor.clip_gradients),
-        "critic": clipped(instantiate(cfg.algo.critic.optimizer), cfg.algo.critic.clip_gradients),
-    }
+    txs, opt_states = build_optimizers(cfg, params)
     if state:
         opt_states = state["opt_states"]
         moments = state["moments"]
     else:
-        opt_states = {
-            "wm": txs["wm"].init(params["wm"]),
-            "actor": txs["actor"].init(params["actor"]),
-            "critic": txs["critic"].init(params["critic"]),
-            "step": jnp.zeros((), jnp.int32),
-        }
         moments = init_moments()
 
     seq_len = int(cfg.algo.per_rank_sequence_length)

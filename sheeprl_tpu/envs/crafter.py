@@ -15,13 +15,15 @@ import numpy as np
 from gymnasium import spaces
 
 
-class CrafterWrapper(gym.Wrapper):
+class CrafterWrapper(gym.Env):
+    """Holds the legacy crafter.Env directly — modern gymnasium's Wrapper
+    asserts the core is a gymnasium.Env (see envs/dmc.py note)."""
+
     def __init__(self, id: str, screen_size: Union[Tuple[int, int], int], seed: Optional[int] = None) -> None:
         assert id in {"crafter_reward", "crafter_nonreward"}
         if isinstance(screen_size, int):
             screen_size = (screen_size,) * 2
-        env = crafter.Env(size=screen_size, seed=seed, reward=(id == "crafter_reward"))
-        super().__init__(env)
+        self.env = crafter.Env(size=screen_size, seed=seed, reward=(id == "crafter_reward"))
         self.observation_space = spaces.Dict(
             {
                 "rgb": spaces.Box(
@@ -38,6 +40,11 @@ class CrafterWrapper(gym.Wrapper):
         self.action_space.seed(seed)
         self._render_mode = "rgb_array"
         self._metadata = {"render_fps": 30}
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self.env, name)
 
     @property
     def render_mode(self) -> Optional[str]:

@@ -61,7 +61,10 @@ def _norm(name: str) -> str:
     return "_".join(name.split(" "))
 
 
-class MineDojoWrapper(gym.Wrapper):
+class MineDojoWrapper(gym.Env):
+    """Holds the legacy minedojo env directly — modern gymnasium's Wrapper
+    asserts the core is a gymnasium.Env (see envs/dmc.py note)."""
+
     def __init__(
         self,
         id: str,
@@ -93,7 +96,7 @@ class MineDojoWrapper(gym.Wrapper):
                 f"given {self._pos['pitch']}"
             )
 
-        env = minedojo.make(
+        self.env = minedojo.make(
             task_id=id,
             image_size=(height, width),
             world_seed=seed,
@@ -101,7 +104,6 @@ class MineDojoWrapper(gym.Wrapper):
             break_speed_multiplier=self._break_speed_multiplier,
             **kwargs,
         )
-        super().__init__(env)
         self._inventory: Dict[str, Any] = {}
         self._inventory_names = None
         self._inventory_max = np.zeros(N_ALL_ITEMS)
@@ -132,6 +134,8 @@ class MineDojoWrapper(gym.Wrapper):
         return self._render_mode
 
     def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
         return getattr(self.env, name)
 
     def _convert_inventory(self, inventory: Dict[str, Any]) -> np.ndarray:
@@ -280,7 +284,7 @@ class MineDojoWrapper(gym.Wrapper):
 
     def render(self):
         if self.render_mode == "human":
-            return super().render()
+            return self.env.render()
         if self.render_mode == "rgb_array":
             prev = self.env.unwrapped._prev_obs
             return None if prev is None else prev["rgb"]

@@ -34,7 +34,10 @@ N_ALL_ITEMS = len(mc.ALL_ITEMS)
 ITEM_NAME_TO_ID = dict(zip(mc.ALL_ITEMS, range(N_ALL_ITEMS)))
 
 
-class MineRLWrapper(gym.Wrapper):
+class MineRLWrapper(gym.Env):
+    """Holds the legacy minerl env directly — modern gymnasium's Wrapper
+    asserts the core is a gymnasium.Env (see envs/dmc.py note)."""
+
     def __init__(
         self,
         id: str,
@@ -59,8 +62,7 @@ class MineRLWrapper(gym.Wrapper):
         self._sticky_jump_counter = 0
         self._break_speed_multiplier = break_speed_multiplier
         self._multihot_inventory = multihot_inventory
-        env = legacy_gym.make(id)
-        super().__init__(env)
+        self.env = legacy_gym.make(id)
 
         # flat Discrete action space over the MineRL dict space
         # (reference minerl.py:100-141)
@@ -120,6 +122,8 @@ class MineRLWrapper(gym.Wrapper):
         return self._render_mode
 
     def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
         return getattr(self.env, name)
 
     def _item_index(self, name: str) -> Optional[int]:

@@ -24,11 +24,13 @@ class JoypadSpaceCustomReset(JoypadSpace):
         return self.env.reset(seed=seed, options=options)
 
 
-class SuperMarioBrosWrapper(gym.Wrapper):
+class SuperMarioBrosWrapper(gym.Env):
+    """Holds the legacy nes_py env directly — modern gymnasium's Wrapper
+    asserts the core is a gymnasium.Env (see envs/dmc.py note)."""
+
     def __init__(self, id: str, action_space: str = "simple", render_mode: str = "rgb_array"):
         env = gsmb.make(id)
-        env = JoypadSpaceCustomReset(env, ACTIONS_SPACE_MAP[action_space])
-        super().__init__(env)
+        self.env = env = JoypadSpaceCustomReset(env, ACTIONS_SPACE_MAP[action_space])
         self._render_mode = render_mode
         self.observation_space = gym.spaces.Dict(
             {
@@ -41,6 +43,11 @@ class SuperMarioBrosWrapper(gym.Wrapper):
             }
         )
         self.action_space = gym.spaces.Discrete(env.action_space.n)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self.env, name)
 
     @property
     def render_mode(self) -> str:

@@ -137,11 +137,16 @@ def make_env(
         env = instantiate(wrapper_cfg, **instantiate_kwargs)
 
         # atari (frameskip in ALE) and DIAMBRA (engine-side repeat_action)
-        # repeat internally — don't double-apply (reference env.py:76-81)
+        # repeat internally — don't double-apply (reference env.py:76-81
+        # checks the gym spec's entry point for "atari")
         env_target = str(wrapper_cfg.get("_target_", "")).lower()
+        try:
+            env_spec = str(gym.spec(str(cfg.env.get("id", ""))).entry_point).lower()
+        except Exception:
+            env_spec = ""
         if (
             cfg.env.get("action_repeat", 1) > 1
-            and "atari" not in str(cfg.env.get("id", "")).lower()
+            and "atari" not in env_spec
             and "diambra" not in env_target
         ):
             env = ActionRepeat(env, cfg.env.action_repeat)
