@@ -8,15 +8,9 @@ NOTE: on axon-tunneled machines a sitecustomize registers the TPU backend at
 interpreter start and forces `jax_platforms`; env vars alone don't stick, so
 we set the config knob after importing jax.
 """
-import os
+from sheeprl_tpu.utils.virtual_mesh import force_virtual_cpu_mesh
 
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu_mesh(8)
 
 import pytest
 
