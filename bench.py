@@ -1,18 +1,23 @@
-"""Headline benchmark driver. Prints ONE JSON line:
+"""Headline benchmark driver. Prints one JSON record per metric, one per
+line; the LAST line is the headline record (the driver parses the last line):
 
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-Default (`python bench.py`): DreamerV3-S train-step throughput on the
-attached chip — the flagship workload (see bench_dv3.py for the recipe and
-the baseline derivation: reference MsPacman-100K = 14 h on an RTX 3080 ⇒
-1.98 policy-steps/s end-to-end, README.md:45-51 / BASELINE.md). The bench
-times the full jitted gradient step on Atari-shaped synthetic batches, so it
-measures the device compute path without env-SDK or host-tunnel latency.
+Default (`python bench.py`): two DreamerV3 measurements —
 
-`python bench.py ppo`: the reference's PPO wall-clock recipe (CartPole-v1,
-65_536 policy steps, rollout 128, 4 envs — configs/exp/ppo_benchmarks.yaml,
-81.27 s on 4 CPUs ⇒ ~806 SPS, README.md:97-112). End-to-end including env
-stepping; on a network-tunneled accelerator this is dispatch-latency-bound.
+1. compute-only: the full jitted DreamerV3-S gradient step on Atari-shaped
+   synthetic batches (bench_dv3.py; baseline MsPacman-100K = 14 h on an
+   RTX 3080 ⇒ 1.98 policy-steps/s, README.md:45-51 / BASELINE.md), and
+2. end-to-end (headline): the reference's own 16_384-step DreamerV3
+   micro-bench recipe (configs/exp/dreamer_v3_benchmarks.yaml — tiny nets,
+   replay_ratio 0.0625, 1 env; BASELINE.md 1589.30 s on 4 CPUs), run through
+   the real CLI: env stepping + replay buffer + staged host→HBM prefetch +
+   train, with env=dummy standing in for MsPacman (ale-py is not installed;
+   the obs/action shapes and therefore the XLA programs are identical).
+
+Subcommands: `ppo` (reference CartPole wall-clock recipe, 81.27 s baseline),
+`dv1` / `dv2` / `dv3` (the reference Dreamer micro-benches, 2207.13 s /
+906.42 s / 1589.30 s baselines), `dv3_step` (compute-only only).
 """
 from __future__ import annotations
 
@@ -25,8 +30,17 @@ sys.path.insert(0, ".")
 PPO_BASELINE_SECONDS = 81.27  # reference README.md:97-112 (v0.5.5, 4 CPU)
 PPO_TOTAL_STEPS = 65_536
 
+# reference README.md:150-176 (v0.5.5, 4 CPU): 16_384-step micro-benches
+DREAMER_BASELINE_SECONDS = {"dv1": 2207.13, "dv2": 906.42, "dv3": 1589.30}
+DREAMER_EXPS = {
+    "dv1": "dreamer_v1_benchmarks",
+    "dv2": "dreamer_v2_benchmarks",
+    "dv3": "dreamer_v3_benchmarks",
+}
+DREAMER_TOTAL_STEPS = 16_384
 
-def bench_ppo() -> None:
+
+def bench_ppo() -> dict:
     from sheeprl_tpu.cli import run
 
     t0 = time.perf_counter()
@@ -39,25 +53,66 @@ def bench_ppo() -> None:
     elapsed = time.perf_counter() - t0
     sps = PPO_TOTAL_STEPS / elapsed
     baseline_sps = PPO_TOTAL_STEPS / PPO_BASELINE_SECONDS
-    print(
-        json.dumps(
-            {
-                "metric": "PPO CartPole-v1 65536-step policy SPS (reference recipe)",
-                "value": round(sps, 2),
-                "unit": "env steps/sec",
-                "vs_baseline": round(sps / baseline_sps, 3),
-            }
-        )
+    return {
+        "metric": "PPO CartPole-v1 65536-step policy SPS (reference recipe, end-to-end)",
+        "value": round(sps, 2),
+        "unit": "env steps/sec",
+        "vs_baseline": round(sps / baseline_sps, 3),
+    }
+
+
+def bench_dreamer_e2e(which: str) -> dict:
+    """The reference's 16_384-step Dreamer micro-bench, end to end through
+    the CLI (env stepping + replay + prefetch + train), dummy Atari shapes."""
+    from sheeprl_tpu.cli import run
+
+    t0 = time.perf_counter()
+    run(
+        [
+            f"exp={DREAMER_EXPS[which]}",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "buffer.checkpoint=False",
+            "buffer.memmap=False",
+            "checkpoint.every=0",
+            "checkpoint.save_last=False",
+            "metric.log_level=0",
+        ]
     )
+    elapsed = time.perf_counter() - t0
+    sps = DREAMER_TOTAL_STEPS / elapsed
+    baseline_sps = DREAMER_TOTAL_STEPS / DREAMER_BASELINE_SECONDS[which]
+    return {
+        "metric": f"Dreamer{which.upper().replace('DV', 'V')} 16384-step micro-bench policy "
+        "SPS (reference recipe end-to-end: env+replay+train, dummy Atari shapes, ckpt off)",
+        "value": round(sps, 2),
+        "unit": "env steps/sec",
+        "vs_baseline": round(sps / baseline_sps, 3),
+        "elapsed_seconds": round(elapsed, 2),
+        "baseline_seconds": DREAMER_BASELINE_SECONDS[which],
+    }
 
 
 def main() -> None:
-    if len(sys.argv) > 1 and sys.argv[1] == "ppo":
-        bench_ppo()
+    arg = sys.argv[1] if len(sys.argv) > 1 else ""
+    if arg == "ppo":
+        print(json.dumps(bench_ppo()))
+    elif arg in DREAMER_EXPS:
+        print(json.dumps(bench_dreamer_e2e(arg)))
+    elif arg == "dv3_step":
+        import bench_dv3
+
+        print(json.dumps(bench_dv3.record()))
     else:
         import bench_dv3
 
-        bench_dv3.main()
+        step_rec = bench_dv3.record()
+        print(json.dumps(step_rec), flush=True)
+        e2e_rec = bench_dreamer_e2e("dv3")
+        e2e_rec["extra_metrics"] = [step_rec]
+        print(json.dumps(e2e_rec))
 
 
 if __name__ == "__main__":

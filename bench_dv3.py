@@ -26,7 +26,7 @@ SEQ = 64
 N_ACTIONS = 9  # MsPacman
 
 
-def main() -> None:
+def record() -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -83,35 +83,39 @@ def main() -> None:
         "truncated": jnp.zeros((SEQ, BATCH, 1), jnp.float32),
         "is_first": jnp.zeros((SEQ, BATCH, 1), jnp.float32),
     }
-    sharding = dist.sharding(None, "dp")
-    batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
+    sharding = dist.sharding(None, None, "dp")  # train takes [G, T, B, ...]
+    batch = {k: jax.device_put(v[None], sharding) for k, v in batch.items()}
 
     tkey = jax.random.key(1)
     # compile + settle
     for _ in range(3):
         tkey, k = jax.random.split(tkey)
-        params, opt_states, moments, metrics = train(params, opt_states, moments, batch, k)
+        params, opt_states, moments, metrics = train(
+            params, opt_states, moments, batch, jax.random.split(k, 1)
+        )
     jax.block_until_ready(metrics)
 
     reps = 20
     t0 = time.perf_counter()
     for _ in range(reps):
         tkey, k = jax.random.split(tkey)
-        params, opt_states, moments, metrics = train(params, opt_states, moments, batch, k)
+        params, opt_states, moments, metrics = train(
+            params, opt_states, moments, batch, jax.random.split(k, 1)
+        )
     jax.block_until_ready(metrics)
     elapsed = time.perf_counter() - t0
     sps = reps / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": "DreamerV3-S Atari-shape gradient steps/sec/chip "
-                "(≈ env-steps/sec at replay_ratio 1; baseline: MsPacman-100K 14h on RTX 3080)",
-                "value": round(sps, 3),
-                "unit": "steps/s",
-                "vs_baseline": round(sps / BASELINE_STEPS_PER_SEC, 3),
-            }
-        )
-    )
+    return {
+        "metric": "DreamerV3-S Atari-shape gradient steps/sec/chip "
+        "(≈ env-steps/sec at replay_ratio 1; baseline: MsPacman-100K 14h on RTX 3080)",
+        "value": round(sps, 3),
+        "unit": "steps/s",
+        "vs_baseline": round(sps / BASELINE_STEPS_PER_SEC, 3),
+    }
+
+
+def main() -> None:
+    print(json.dumps(record()))
 
 
 if __name__ == "__main__":

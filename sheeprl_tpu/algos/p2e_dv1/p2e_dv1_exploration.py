@@ -29,7 +29,7 @@ import numpy as np
 import optax
 
 from ...config import Config, instantiate
-from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer, StagedPrefetcher
 from ...distributions import Bernoulli, Independent, Normal
 from ...optim import clipped
 from ...parallel import Distributed
@@ -321,8 +321,21 @@ def make_train_fn(
         return params, opt_states, metrics
 
     @partial(jax.jit, donate_argnums=(0, 1))
-    def train(params, opt_states, batch, key):
-        return one_step(params, opt_states, batch, key)
+    def train(params, opt_states, batches, keys):
+        """G gradient steps in one device call: scan `one_step` over
+        `batches` [G, T, B, ...] / `keys` [G]; metrics come back [G]-shaped
+        (see dreamer_v3.make_train_fn for the rationale)."""
+
+        def body(carry, xs):
+            params, opt_states = carry
+            batch, key = xs
+            params, opt_states, metrics = one_step(params, opt_states, batch, key)
+            return (params, opt_states), metrics
+
+        (params, opt_states), metrics = jax.lax.scan(
+            body, (params, opt_states), (batches, keys)
+        )
+        return params, opt_states, metrics
 
     return train
 
@@ -416,6 +429,17 @@ def main(dist: Distributed, cfg: Config) -> None:
     last_checkpoint = state["last_checkpoint"] if state else 0
     clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
 
+    def _host_sample(g):
+        # cnn obs stay uint8 (device-side normalize casts them); the rest f32
+        s = rb.sample(batch_size, sequence_length=seq_len, n_samples=g)
+        return {
+            k: np.asarray(v) if k in cnn_keys else np.asarray(v, np.float32)
+            for k, v in s.items()
+        }
+
+    prefetch = StagedPrefetcher(_host_sample, dist.sharding(None, None, "dp"))
+    pending_metrics: list = []
+
     obs, _ = envs.reset(seed=cfg.seed)
     player_state = player_init()
 
@@ -493,30 +517,35 @@ def main(dist: Distributed, cfg: Config) -> None:
             per_rank_gradient_steps = ratio(policy_step / dist.world_size)
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
-                    sharding = dist.sharding(None, "dp")
-                    for _ in range(per_rank_gradient_steps):
-                        sample = rb.sample(batch_size, sequence_length=seq_len, n_samples=1)
-                        batch = {
-                            k: jax.device_put(np.asarray(v[0], np.float32), sharding)
-                            for k, v in sample.items()
-                        }
-                        root_key, tk = jax.random.split(root_key)
-                        params, opt_states, metrics = train(params, opt_states, batch, tk)
-                for k, v in metrics.items():
-                    aggregator.update(k, np.asarray(v))
+                    batches = prefetch.take(per_rank_gradient_steps)  # [G, T, B, ...]
+                    root_key, sub = jax.random.split(root_key)
+                    params, opt_states, metrics = train(
+                        params,
+                        opt_states,
+                        batches,
+                        jax.random.split(sub, per_rank_gradient_steps),
+                    )
+                pending_metrics.append(metrics)
+            if policy_step < total_steps:
+                prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
 
-        if rank == 0 and logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
-            logger.log_metrics(aggregator.compute(), policy_step)
+        if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
+            for m in pending_metrics:  # host-sync deferred to log cadence
+                for k, v in m.items():
+                    aggregator.update(k, np.asarray(v))
+            pending_metrics.clear()
+            if rank == 0 and logger is not None:
+                logger.log_metrics(aggregator.compute(), policy_step)
+                timings = timer.compute()
+                if timings.get("Time/env_interaction_time"):
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (policy_step - last_log)
+                            / timings["Time/env_interaction_time"]
+                        },
+                        policy_step,
+                    )
             aggregator.reset()
-            timings = timer.compute()
-            if timings.get("Time/env_interaction_time"):
-                logger.log_metrics(
-                    {
-                        "Time/sps_env_interaction": (policy_step - last_log)
-                        / timings["Time/env_interaction_time"]
-                    },
-                    policy_step,
-                )
             timer.reset()
             last_log = policy_step
 

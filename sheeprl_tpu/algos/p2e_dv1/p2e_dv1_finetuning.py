@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...config import Config, instantiate
-from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer, StagedPrefetcher
 from ...optim import clipped
 from ...parallel import Distributed
 from ...utils.checkpoint import CheckpointManager
@@ -147,6 +147,17 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
     clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
     actor_type = str(cfg.algo.player.actor_type)
 
+    def _host_sample(g):
+        # cnn obs stay uint8 (device-side normalize casts them); the rest f32
+        s = rb.sample(batch_size, sequence_length=seq_len, n_samples=g)
+        return {
+            k: np.asarray(v) if k in cnn_keys else np.asarray(v, np.float32)
+            for k, v in s.items()
+        }
+
+    prefetch = StagedPrefetcher(_host_sample, dist.sharding(None, None, "dp"))
+    pending_metrics: list = []
+
     obs, _ = envs.reset(seed=cfg.seed)
     player_state = player_init()
 
@@ -221,20 +232,25 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
             per_rank_gradient_steps = ratio(policy_step / dist.world_size)
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
-                    sharding = dist.sharding(None, "dp")
-                    for _ in range(per_rank_gradient_steps):
-                        sample = rb.sample(batch_size, sequence_length=seq_len, n_samples=1)
-                        batch = {
-                            k: jax.device_put(np.asarray(v[0], np.float32), sharding)
-                            for k, v in sample.items()
-                        }
-                        root_key, tk = jax.random.split(root_key)
-                        params, opt_states, metrics = train(params, opt_states, batch, tk)
-                for k, v in metrics.items():
-                    aggregator.update(k, np.asarray(v))
+                    batches = prefetch.take(per_rank_gradient_steps)  # [G, T, B, ...]
+                    root_key, sub = jax.random.split(root_key)
+                    params, opt_states, metrics = train(
+                        params,
+                        opt_states,
+                        batches,
+                        jax.random.split(sub, per_rank_gradient_steps),
+                    )
+                pending_metrics.append(metrics)
+            if policy_step < total_steps:
+                prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
 
-        if rank == 0 and logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
-            logger.log_metrics(aggregator.compute(), policy_step)
+        if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
+            for m in pending_metrics:  # host-sync deferred to log cadence
+                for k, v in m.items():
+                    aggregator.update(k, np.asarray(v))
+            pending_metrics.clear()
+            if rank == 0 and logger is not None:
+                logger.log_metrics(aggregator.compute(), policy_step)
             aggregator.reset()
             timer.reset()
             last_log = policy_step

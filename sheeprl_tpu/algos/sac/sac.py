@@ -26,7 +26,7 @@ import numpy as np
 import optax
 
 from ...config import Config, instantiate
-from ...data import ReplayBuffer
+from ...data import ReplayBuffer, StagedPrefetcher
 from ...parallel import Distributed
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
@@ -193,6 +193,13 @@ def main(dist: Distributed, cfg: Config) -> None:
     last_checkpoint = state["last_checkpoint"] if state else 0
     cumulative_grad_steps = state["cumulative_grad_steps"] if state else 0
 
+    def _host_sample(g):
+        s = rb.sample(batch_size * g, sample_next_obs=False, n_samples=1)
+        return {k: np.asarray(v).reshape(g, batch_size, *v.shape[2:]) for k, v in s.items()}
+
+    prefetch = StagedPrefetcher(_host_sample, dist.sharding(None, "dp"))  # [G, B, ...]
+    pending_metrics: list = []
+
     obs, _ = envs.reset(seed=cfg.seed)
     obs_vec = flatten_obs(obs, mlp_keys, num_envs)
 
@@ -236,39 +243,33 @@ def main(dist: Distributed, cfg: Config) -> None:
             per_rank_gradient_steps = ratio(policy_step / dist.world_size)
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
-                    sample = rb.sample(
-                        batch_size * per_rank_gradient_steps,
-                        sample_next_obs=False,
-                        n_samples=1,
-                    )
-                    mb_sharding = dist.sharding(None, "dp")  # [G, B, ...] — shard batch axis
-                    batches = {
-                        k: jax.device_put(
-                            np.asarray(v).reshape(per_rank_gradient_steps, batch_size, *v.shape[2:]),
-                            mb_sharding,
-                        )
-                        for k, v in sample.items()
-                    }
+                    batches = prefetch.take(per_rank_gradient_steps)  # [G, B, ...]
                     root_key, sub = jax.random.split(root_key)
                     keys = jax.random.split(sub, per_rank_gradient_steps)
                     params, opt_states, metrics = train(params, opt_states, batches, keys)
                     cumulative_grad_steps += per_rank_gradient_steps
-                for k, v in metrics.items():
-                    aggregator.update(k, np.asarray(v))
+                pending_metrics.append(metrics)
+            if policy_step < total_steps:
+                prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
 
-        if rank == 0 and logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
-            logger.log_metrics(aggregator.compute(), policy_step)
+        if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
+            for m in pending_metrics:  # host-sync deferred to log cadence
+                for k, v in m.items():
+                    aggregator.update(k, np.asarray(v))
+            pending_metrics.clear()
+            if rank == 0 and logger is not None:
+                logger.log_metrics(aggregator.compute(), policy_step)
+                timings = timer.compute()
+                if timings.get("Time/train_time"):
+                    logger.log_metrics(
+                        {"Time/sps_train": (policy_step - last_log) / timings["Time/train_time"]}, policy_step
+                    )
+                if policy_step > 0:
+                    logger.log_metrics(
+                        {"Params/replay_ratio": cumulative_grad_steps * dist.world_size / policy_step},
+                        policy_step,
+                    )
             aggregator.reset()
-            timings = timer.compute()
-            if timings.get("Time/train_time"):
-                logger.log_metrics(
-                    {"Time/sps_train": (policy_step - last_log) / timings["Time/train_time"]}, policy_step
-                )
-            if policy_step > 0:
-                logger.log_metrics(
-                    {"Params/replay_ratio": cumulative_grad_steps * dist.world_size / policy_step},
-                    policy_step,
-                )
             timer.reset()
             last_log = policy_step
 

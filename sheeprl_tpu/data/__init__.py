@@ -5,7 +5,7 @@ from .buffers import (
     SequentialReplayBuffer,
 )
 from .memmap import MemmapArray
-from .prefetch import DevicePrefetcher
+from .prefetch import DevicePrefetcher, StagedPrefetcher
 
 __all__ = [
     "EnvIndependentReplayBuffer",
@@ -14,4 +14,5 @@ __all__ = [
     "SequentialReplayBuffer",
     "MemmapArray",
     "DevicePrefetcher",
+    "StagedPrefetcher",
 ]

@@ -15,6 +15,52 @@ from typing import Any, Callable, Iterator, Optional
 import jax
 
 
+class StagedPrefetcher:
+    """Single-threaded double buffering for Template-B (off-policy) loops.
+
+    JAX dispatch is asynchronous: right after a train step is dispatched, the
+    loop calls `stage(g_next)` — the host samples the next ``[G, ...]`` batch
+    and dispatches its host→HBM transfer while the device is still computing
+    the current step. At the next train phase `take(g)` returns the staged
+    device batch, so the device never waits on replay sampling or transfer.
+
+    Staging one iteration ahead means a staged batch cannot contain the very
+    latest ``num_envs`` transitions; for off-policy replay from a large
+    buffer this is statistically irrelevant (and the first train phase, or
+    any `g` misprediction, falls back to a synchronous sample).
+    """
+
+    def __init__(self, sample_fn: Callable[[int], Any], sharding: Optional[Any] = None):
+        self._sample = sample_fn
+        self._sharding = sharding
+        self._staged: Optional[tuple] = None  # (g, device_batch)
+
+    def _put(self, batch: Any) -> Any:
+        if self._sharding is None:
+            return jax.tree.map(jax.numpy.asarray, batch)
+        return jax.tree.map(lambda x: jax.device_put(x, self._sharding), batch)
+
+    def stage(self, g: int) -> None:
+        """Sample a [g, ...] batch and dispatch its device transfer now.
+        Staging runs one iteration ahead of the consuming train phase, so at
+        the warmup boundary the buffer may not be able to serve the sample
+        yet — then nothing is staged and `take` samples synchronously."""
+        if g <= 0:
+            self._staged = None
+            return
+        try:
+            self._staged = (g, self._put(self._sample(g)))
+        except ValueError:
+            self._staged = None
+
+    def take(self, g: int) -> Any:
+        """The staged batch if it matches `g`, else a synchronous sample."""
+        staged, self._staged = self._staged, None
+        if staged is not None and staged[0] == g:
+            return staged[1]
+        return self._put(self._sample(g))
+
+
 class DevicePrefetcher:
     """Wraps a `sample_fn() -> host_batch` into a double-buffered device
     iterator. `depth` batches are staged ahead (device_put is async in JAX,

@@ -37,6 +37,19 @@ class Ratio:
         self._prev += repeats / self._ratio
         return int(repeats)
 
+    def peek(self, step: float) -> int:
+        """Predict what `__call__(step)` would return, without consuming the
+        budget — used to stage the next replay batch while the device is busy
+        (the controller is deterministic, so the prediction is exact)."""
+        if self._ratio == 0:
+            return 0
+        if self._prev is None:
+            repeats = int(self._pretrain_steps * self._ratio)
+            if self._pretrain_steps > 0 and repeats == 0:
+                repeats = 1
+            return repeats
+        return int(round((step - self._prev) * self._ratio))
+
     def state_dict(self) -> Dict[str, Any]:
         return {"_ratio": self._ratio, "_prev": self._prev, "_pretrain_steps": self._pretrain_steps}
 
