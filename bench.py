@@ -1,5 +1,6 @@
 """Headline benchmark driver. Prints one JSON record per metric, one per
-line; the LAST line is the headline record (the driver parses the last line):
+line; the LAST line on stdout is the headline record (the driver parses the
+last line):
 
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
@@ -15,17 +16,32 @@ Default (`python bench.py`): two DreamerV3 measurements —
    train, with env=dummy standing in for MsPacman (ale-py is not installed;
    the obs/action shapes and therefore the XLA programs are identical).
 
+Robustness contract (the round-2 run broke it — BENCH_r02 rc=124):
+* each measurement runs in a SUBPROCESS with its own wall-clock budget
+  (`BENCH_E2E_BUDGET_S`, default 1500 s; `BENCH_STEP_BUDGET_S`, default
+  900 s), so a wedged device link cannot hang the whole bench;
+* inside a measurement all training output is redirected to stderr — the
+  only thing a subprocess writes to stdout is its one JSON line;
+* if the end-to-end leg fails or times out, the compute-only record is
+  printed as the headline (with `e2e_error` noting why), so the driver
+  always gets a parseable last line.
+
 Subcommands: `ppo` (reference CartPole wall-clock recipe, 81.27 s baseline),
 `dv1` / `dv2` / `dv3` (the reference Dreamer micro-benches, 2207.13 s /
 906.42 s / 1589.30 s baselines), `dv3_step` (compute-only only).
+`BENCH_DREAMER_STEPS` overrides the 16_384-step count (debugging only — the
+recorded `vs_baseline` stays an SPS ratio either way).
 """
 from __future__ import annotations
 
+import contextlib
 import json
+import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 PPO_BASELINE_SECONDS = 81.27  # reference README.md:97-112 (v0.5.5, 4 CPU)
 PPO_TOTAL_STEPS = 65_536
@@ -37,19 +53,20 @@ DREAMER_EXPS = {
     "dv2": "dreamer_v2_benchmarks",
     "dv3": "dreamer_v3_benchmarks",
 }
-DREAMER_TOTAL_STEPS = 16_384
+DREAMER_TOTAL_STEPS = int(os.environ.get("BENCH_DREAMER_STEPS", 16_384))
 
 
 def bench_ppo() -> dict:
     from sheeprl_tpu.cli import run
 
     t0 = time.perf_counter()
-    run(
-        [
-            "exp=ppo_benchmarks",
-            f"algo.total_steps={PPO_TOTAL_STEPS}",
-        ]
-    )
+    with contextlib.redirect_stdout(sys.stderr):
+        run(
+            [
+                "exp=ppo_benchmarks",
+                f"algo.total_steps={PPO_TOTAL_STEPS}",
+            ]
+        )
     elapsed = time.perf_counter() - t0
     sps = PPO_TOTAL_STEPS / elapsed
     baseline_sps = PPO_TOTAL_STEPS / PPO_BASELINE_SECONDS
@@ -58,41 +75,78 @@ def bench_ppo() -> dict:
         "value": round(sps, 2),
         "unit": "env steps/sec",
         "vs_baseline": round(sps / baseline_sps, 3),
+        "elapsed_seconds": round(elapsed, 2),
+        "baseline_seconds": PPO_BASELINE_SECONDS,
     }
 
 
 def bench_dreamer_e2e(which: str) -> dict:
     """The reference's 16_384-step Dreamer micro-bench, end to end through
-    the CLI (env stepping + replay + prefetch + train), dummy Atari shapes."""
+    the CLI (env stepping + replay + prefetch + train), dummy Atari shapes.
+    Training/config output goes to stderr; the caller prints the JSON."""
     from sheeprl_tpu.cli import run
 
+    steps = DREAMER_TOTAL_STEPS
     t0 = time.perf_counter()
-    run(
-        [
-            f"exp={DREAMER_EXPS[which]}",
-            "env=dummy",
-            "env.id=discrete_dummy",
-            "algo.cnn_keys.encoder=[rgb]",
-            "algo.mlp_keys.encoder=[]",
-            "buffer.checkpoint=False",
-            "buffer.memmap=False",
-            "checkpoint.every=0",
-            "checkpoint.save_last=False",
-            "metric.log_level=0",
-        ]
-    )
+    with contextlib.redirect_stdout(sys.stderr):
+        run(
+            [
+                f"exp={DREAMER_EXPS[which]}",
+                "env=dummy",
+                "env.id=discrete_dummy",
+                "algo.cnn_keys.encoder=[rgb]",
+                "algo.mlp_keys.encoder=[]",
+                f"algo.total_steps={steps}",
+                f"buffer.size={steps}",
+                "buffer.checkpoint=False",
+                "buffer.memmap=False",
+                "checkpoint.every=0",
+                "checkpoint.save_last=False",
+                "metric.log_level=0",
+                "algo.player.async_refresh=True",
+            ]
+        )
     elapsed = time.perf_counter() - t0
-    sps = DREAMER_TOTAL_STEPS / elapsed
-    baseline_sps = DREAMER_TOTAL_STEPS / DREAMER_BASELINE_SECONDS[which]
+    sps = steps / elapsed
+    baseline_sps = DREAMER_TOTAL_STEPS_REF / DREAMER_BASELINE_SECONDS[which]
     return {
-        "metric": f"Dreamer{which.upper().replace('DV', 'V')} 16384-step micro-bench policy "
+        "metric": f"Dreamer{which.upper().replace('DV', 'V')} {steps}-step micro-bench policy "
         "SPS (reference recipe end-to-end: env+replay+train, dummy Atari shapes, ckpt off)",
         "value": round(sps, 2),
         "unit": "env steps/sec",
         "vs_baseline": round(sps / baseline_sps, 3),
         "elapsed_seconds": round(elapsed, 2),
         "baseline_seconds": DREAMER_BASELINE_SECONDS[which],
+        "steps": steps,
     }
+
+
+DREAMER_TOTAL_STEPS_REF = 16_384  # the baseline recipe's step count
+
+
+def _run_subprocess_record(argv: list, budget_s: float) -> dict | None:
+    """Run `python bench.py <argv>` as a subprocess with a wall-clock budget;
+    return the JSON record from its last stdout line, or None on
+    failure/timeout (details to stderr)."""
+    cmd = [sys.executable, os.path.abspath(__file__)] + argv
+    try:
+        proc = subprocess.run(
+            cmd, stdout=subprocess.PIPE, stderr=sys.stderr, timeout=budget_s, text=True
+        )
+    except subprocess.TimeoutExpired:
+        print(f"[bench] {' '.join(argv)} exceeded {budget_s}s budget", file=sys.stderr)
+        return None
+    if proc.returncode != 0:
+        print(f"[bench] {' '.join(argv)} exited rc={proc.returncode}", file=sys.stderr)
+        return None
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if not lines:
+        return None
+    try:
+        return json.loads(lines[-1])
+    except json.JSONDecodeError:
+        print(f"[bench] {' '.join(argv)} last line not JSON: {lines[-1]!r}", file=sys.stderr)
+        return None
 
 
 def main() -> None:
@@ -104,15 +158,37 @@ def main() -> None:
     elif arg == "dv3_step":
         import bench_dv3
 
-        print(json.dumps(bench_dv3.record()))
+        with contextlib.redirect_stdout(sys.stderr):
+            rec = bench_dv3.record()
+        print(json.dumps(rec))
     else:
-        import bench_dv3
-
-        step_rec = bench_dv3.record()
-        print(json.dumps(step_rec), flush=True)
-        e2e_rec = bench_dreamer_e2e("dv3")
-        e2e_rec["extra_metrics"] = [step_rec]
-        print(json.dumps(e2e_rec))
+        step_budget = float(os.environ.get("BENCH_STEP_BUDGET_S", 900))
+        e2e_budget = float(os.environ.get("BENCH_E2E_BUDGET_S", 1500))
+        step_rec = _run_subprocess_record(["dv3_step"], step_budget)
+        if step_rec is not None:
+            print(json.dumps(step_rec), flush=True)
+        e2e_rec = _run_subprocess_record(["dv3"], e2e_budget)
+        if e2e_rec is not None:
+            if step_rec is not None:
+                e2e_rec["extra_metrics"] = [step_rec]
+            print(json.dumps(e2e_rec))
+        elif step_rec is not None:
+            step_rec["e2e_error"] = (
+                "end-to-end leg failed or exceeded its budget; compute-only record promoted"
+            )
+            print(json.dumps(step_rec))
+        else:
+            print(
+                json.dumps(
+                    {
+                        "metric": "DreamerV3 bench",
+                        "value": 0.0,
+                        "unit": "env steps/sec",
+                        "vs_baseline": 0.0,
+                        "error": "both bench legs failed (see stderr)",
+                    }
+                )
+            )
 
 
 if __name__ == "__main__":

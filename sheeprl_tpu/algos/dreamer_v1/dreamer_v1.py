@@ -30,8 +30,9 @@ from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer, StagedPr
 from ...distributions import Bernoulli, Independent, Normal
 from ...optim import clipped
 from ...parallel import Distributed
+from ...parallel.placement import make_param_mirror, player_device
 from ...utils.checkpoint import CheckpointManager
-from ...utils.env import episode_stats, vectorize
+from ...utils.env import episode_stats, patch_restarted_envs, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
@@ -279,7 +280,9 @@ def main(dist: Distributed, cfg: Config) -> None:
     if rank == 0:
         save_configs(cfg, log_dir)
 
-    envs = vectorize(cfg, cfg.seed, rank, log_dir)
+    # crash-prone suites restart in place; the loop patches the buffer via
+    # patch_restarted_envs (reference dreamer_v3.py:385-399)
+    envs = vectorize(cfg, cfg.seed, rank, log_dir, restart_handled_by_loop=True)
     obs_space = envs.single_observation_space
     action_space = envs.single_action_space
     num_envs = int(cfg.env.num_envs)
@@ -338,6 +341,10 @@ def main(dist: Distributed, cfg: Config) -> None:
     player_init, player_step_fn, expl_amount_at = make_player(
         wm, actor, cfg, actions_dim, is_continuous, num_envs
     )
+    # Actor/learner split (parallel/placement.py)
+    mirror, pdev, player_key, root_key = make_param_mirror(
+        cfg, dist.local_device, {"wm": params["wm"], "actor": params["actor"]}, root_key
+    )
 
     aggregator = MetricAggregator(
         {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
@@ -367,7 +374,7 @@ def main(dist: Distributed, cfg: Config) -> None:
     pending_metrics: list = []
 
     obs, _ = envs.reset(seed=cfg.seed)
-    player_state = player_init()
+    player_state = jax.device_put(player_init(), pdev)
 
     # row 0: reset obs, zero action/reward (reference :545-556 — DV1 stores no
     # is_first; its RSSM never resets mid-sequence)
@@ -393,12 +400,11 @@ def main(dist: Distributed, cfg: Config) -> None:
                         oh.append(np.eye(adim, dtype=np.float32)[acts2d[:, j]])
                     actions_np = np.concatenate(oh, axis=-1)
             else:
-                device_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
-                root_key, k = jax.random.split(root_key)
+                host_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
                 expl_amount = expl_amount_at(policy_step)
                 aggregator.update("Params/exploration_amount", expl_amount)
-                env_actions, actions_cat, player_state = player_step_fn(
-                    params, device_obs, player_state, k, expl_amount=expl_amount
+                env_actions, actions_cat, player_state, player_key = player_step_fn(
+                    mirror.current(), host_obs, player_state, player_key, expl_amount=expl_amount
                 )
                 actions_np = np.asarray(actions_cat)
                 actions_env = np.asarray(env_actions)
@@ -430,13 +436,19 @@ def main(dist: Distributed, cfg: Config) -> None:
             step_data["rewards"] = clip_rewards_fn(
                 np.asarray(rewards, np.float32).reshape(1, num_envs, 1)
             )
+
+            # in-flight env restart → truncation boundary + fresh recurrent
+            # state (reference dreamer_v3.py:595-608 / patch_restarted_envs)
+            restarted = patch_restarted_envs(info, dones, rb, step_data)
+            if restarted is not None:
+                player_state = player_init(restarted, player_state)
             rb.add(step_data)
 
             dones_idxes = np.nonzero(dones)[0].tolist()
             if dones_idxes:
                 mask = np.zeros((num_envs,), bool)
                 mask[dones_idxes] = True
-                player_state = player_init(jnp.asarray(mask), player_state)
+                player_state = player_init(mask, player_state)
 
             obs = next_obs
 
@@ -453,6 +465,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                         jax.random.split(sub, per_rank_gradient_steps),
                     )
                 pending_metrics.append(metrics)
+                mirror.refresh({"wm": params["wm"], "actor": params["actor"]})
             if policy_step < total_steps:
                 prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
 
@@ -490,7 +503,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                 "rng": root_key,
             }
             if cfg.buffer.checkpoint:
-                ckpt_state["rb"] = rb.state_dict()
+                ckpt_state["rb"] = rb.checkpoint_state_dict()
             ckpt.save(policy_step, ckpt_state)
 
     envs.close()
@@ -498,13 +511,14 @@ def main(dist: Distributed, cfg: Config) -> None:
         test_cfg = Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}})
         test_env = vectorize(test_cfg, cfg.seed, rank, log_dir).envs[0]
         t_init, t_step, _ = make_player(wm, actor, cfg, actions_dim, is_continuous, 1)
-        t_state = t_init()
+        t_params = jax.device_put({"wm": params["wm"], "actor": params["actor"]}, pdev)
+        t_state = jax.device_put(t_init(), pdev)
 
         def _step(o, s, k, greedy):
-            env_actions, _, s = t_step(params, o, s, k, greedy)
-            return env_actions, s
+            env_actions, _, s, k = t_step(t_params, o, s, k, greedy)
+            return env_actions, s, k
 
-        test(_step, t_state, test_env, cfg, log_dir, logger)
+        test(_step, t_state, test_env, cfg, log_dir, logger, device=pdev)
     if rank == 0 and not cfg.model_manager.disabled:
         from ...utils.model_manager import register_model
 
@@ -539,10 +553,12 @@ def evaluate_dreamer_v1(dist: Distributed, cfg: Config, state: Dict[str, Any]) -
         dist, cfg, env.observation_space, actions_dim, is_continuous, root_key, state["params"]
     )
     t_init, t_step, _ = make_player(wm, actor, cfg, actions_dim, is_continuous, 1)
-    t_state = t_init()
+    pdev = player_device(cfg, dist.local_device)
+    t_params = jax.device_put({"wm": params["wm"], "actor": params["actor"]}, pdev)
+    t_state = jax.device_put(t_init(), pdev)
 
     def _step(o, s, k, greedy):
-        env_actions, _, s = t_step(params, o, s, k, greedy)
-        return env_actions, s
+        env_actions, _, s, k = t_step(t_params, o, s, k, greedy)
+        return env_actions, s, k
 
-    test(_step, t_state, env, cfg, log_dir, logger)
+    test(_step, t_state, env, cfg, log_dir, logger, device=pdev)

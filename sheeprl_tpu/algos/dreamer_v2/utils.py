@@ -69,15 +69,16 @@ def compute_lambda_values(
 
 def prepare_obs(
     obs: Dict[str, np.ndarray], cnn_keys=(), mlp_keys=(), num_envs: int = 1
-) -> Dict[str, jax.Array]:
-    """Host obs → device; images normalized to [-0.5, 0.5] in the train/player
-    path (reference dreamer_v2/utils.py:105-115 does /255 - 0.5 here; we keep
-    uint8 on host and normalize on device in `normalize_obs`)."""
-    out: Dict[str, jax.Array] = {}
+) -> Dict[str, np.ndarray]:
+    """Shape the host obs for the player; images stay uint8 (normalized on
+    device in `normalize_obs`, reference dreamer_v2/utils.py:105-115 does
+    /255 - 0.5 here). Stays numpy — the jitted player step transfers it to
+    wherever the player params are committed (parallel/placement.py)."""
+    out: Dict[str, np.ndarray] = {}
     for k in cnn_keys:
-        out[k] = jnp.asarray(np.asarray(obs[k]).reshape(num_envs, *np.asarray(obs[k]).shape[-3:]))
+        out[k] = np.asarray(obs[k]).reshape(num_envs, *np.asarray(obs[k]).shape[-3:])
     for k in mlp_keys:
-        out[k] = jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1))
+        out[k] = np.asarray(obs[k], np.float32).reshape(num_envs, -1)
     return out
 
 
@@ -85,8 +86,9 @@ def normalize_obs(obs: Dict[str, jax.Array], cnn_keys) -> Dict[str, jax.Array]:
     return {k: (v.astype(jnp.float32) / 255.0 - 0.5) if k in cnn_keys else v for k, v in obs.items()}
 
 
-def test(player_step, player_state, env, cfg, log_dir: str, logger=None, seed=None) -> float:
-    """Greedy episode with the device-resident player (reference utils.py test)."""
+def test(player_step, player_state, env, cfg, log_dir: str, logger=None, seed=None, device=None) -> float:
+    """Greedy episode with the recurrent player (reference utils.py test).
+    `player_step(obs, state, key, greedy) -> (actions, state, key)`."""
     import gymnasium as gym
 
     done = False
@@ -95,11 +97,12 @@ def test(player_step, player_state, env, cfg, log_dir: str, logger=None, seed=No
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     key = jax.random.key(cfg.seed)
+    if device is not None:
+        key = jax.device_put(key, device)
     is_box = isinstance(env.action_space, gym.spaces.Box)
     while not done:
-        device_obs = prepare_obs(obs, cnn_keys, mlp_keys, 1)
-        key, k = jax.random.split(key)
-        env_actions, player_state = player_step(device_obs, player_state, k, True)
+        host_obs = prepare_obs(obs, cnn_keys, mlp_keys, 1)
+        env_actions, player_state, key = player_step(host_obs, player_state, key, True)
         acts = np.asarray(env_actions)
         if is_box or isinstance(env.action_space, gym.spaces.MultiDiscrete):
             step_action = acts.reshape(env.action_space.shape)

@@ -18,6 +18,8 @@ Losses run in f32; `Moments` normalization happens inside the jit.
 from __future__ import annotations
 
 import os
+import sys
+import time
 from functools import partial
 from typing import Any, Dict, Sequence
 
@@ -40,8 +42,9 @@ from ...distributions import (
 from ...ops import lambda_values as lambda_values_op
 from ...optim import clipped
 from ...parallel import Distributed
+from ...parallel.placement import make_param_mirror, player_device
 from ...utils.checkpoint import CheckpointManager
-from ...utils.env import episode_stats, vectorize
+from ...utils.env import episode_stats, patch_restarted_envs, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
@@ -339,8 +342,12 @@ def make_train_fn(
 
 
 def make_player(wm: WorldModel, actor: Actor, cfg: Config, actions_dim, is_continuous: bool, num_envs: int):
-    """Device-resident player (replaces reference PlayerDV3, agent.py:596-693):
-    state = (recurrent h, stochastic z, last action a), all [N, ...]."""
+    """Recurrent player (replaces reference PlayerDV3, agent.py:596-693):
+    state = (recurrent h, stochastic z, last action a), all [N, ...]. Runs
+    wherever its params are committed (see parallel/placement.py): host CPU
+    backend by default when the learner sits on a remote accelerator. The
+    PRNG key is threaded through the jitted step so the env loop never
+    dispatches a host-side `jax.random.split` per frame."""
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
 
     @jax.jit
@@ -366,7 +373,7 @@ def make_player(wm: WorldModel, actor: Actor, cfg: Config, actions_dim, is_conti
             h,
             method=WorldModel.recurrent_step,
         )
-        k1, k2 = jax.random.split(key)
+        key, k1, k2 = jax.random.split(key, 3)
         z = wm.apply(
             {"params": params["wm"]}, h, embedded, k1, method=WorldModel.representation_step
         )
@@ -377,7 +384,7 @@ def make_player(wm: WorldModel, actor: Actor, cfg: Config, actions_dim, is_conti
             env_actions = a
         else:
             env_actions = jnp.stack([jnp.argmax(x, axis=-1) for x in acts], axis=-1)
-        return env_actions, a, (h, z, a)
+        return env_actions, a, (h, z, a), key
 
     return init_state, step
 
@@ -391,7 +398,9 @@ def main(dist: Distributed, cfg: Config) -> None:
     if rank == 0:
         save_configs(cfg, log_dir)
 
-    envs = vectorize(cfg, cfg.seed, rank, log_dir)
+    # crash-prone suites restart in place; the loop patches the buffer via
+    # patch_restarted_envs (reference dreamer_v3.py:385-399)
+    envs = vectorize(cfg, cfg.seed, rank, log_dir, restart_handled_by_loop=True)
     obs_space = envs.single_observation_space
     action_space = envs.single_action_space
     num_envs = int(cfg.env.num_envs)
@@ -439,6 +448,13 @@ def main(dist: Distributed, cfg: Config) -> None:
 
     train = make_train_fn(wm, actor, critic, txs, cfg, is_continuous, actions_dim)
     player_init, player_step_fn = make_player(wm, actor, cfg, actions_dim, is_continuous, num_envs)
+    # Actor/learner split (parallel/placement.py): per-step inference runs on
+    # the player device (host CPU backend when the mesh is a remote
+    # accelerator); the mirror re-syncs its {wm, actor} subtree after every
+    # train burst — the only place params change.
+    mirror, pdev, player_key, root_key = make_param_mirror(
+        cfg, dist.local_device, {"wm": params["wm"], "actor": params["actor"]}, root_key
+    )
 
     aggregator = MetricAggregator(
         {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
@@ -466,7 +482,7 @@ def main(dist: Distributed, cfg: Config) -> None:
     pending_metrics: list = []
 
     obs, _ = envs.reset(seed=cfg.seed)
-    player_state = player_init(params)
+    player_state = player_init(mirror.params)
 
     # row 0: reset obs, zero action/reward, is_first=1 (reference :536-549)
     step_data: Dict[str, np.ndarray] = {}
@@ -478,7 +494,17 @@ def main(dist: Distributed, cfg: Config) -> None:
     step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["is_first"] = np.ones((1, num_envs, 1), np.float32)
 
+    # SHEEPRL_TPU_PROGRESS=N: wall-clock trace every N policy steps (stderr)
+    _progress = int(os.environ.get("SHEEPRL_TPU_PROGRESS", "0") or 0)
+    _t0 = time.perf_counter()
+
     while policy_step < total_steps:
+        if _progress and policy_step % _progress < num_envs:
+            print(
+                f"[progress] step={policy_step} t={time.perf_counter() - _t0:.1f}s",
+                file=sys.stderr,
+                flush=True,
+            )
         with timer("Time/env_interaction_time"):
             if policy_step <= learning_starts:
                 actions_env = np.stack([action_space.sample() for _ in range(num_envs)])
@@ -491,10 +517,9 @@ def main(dist: Distributed, cfg: Config) -> None:
                         oh.append(np.eye(adim, dtype=np.float32)[acts2d[:, j]])
                     actions_np = np.concatenate(oh, axis=-1)
             else:
-                device_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
-                root_key, k = jax.random.split(root_key)
-                env_actions, actions_cat, player_state = player_step_fn(
-                    params, device_obs, player_state, k
+                host_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
+                env_actions, actions_cat, player_state, player_key = player_step_fn(
+                    mirror.current(), host_obs, player_state, player_key
                 )
                 actions_np = np.asarray(actions_cat)
                 actions_env = np.asarray(env_actions)
@@ -531,6 +556,12 @@ def main(dist: Distributed, cfg: Config) -> None:
                 np.asarray(rewards, np.float32).reshape(1, num_envs, 1)
             )
 
+            # in-flight env restart → truncation boundary + fresh recurrent
+            # state (reference dreamer_v3.py:595-608 / patch_restarted_envs)
+            restarted = patch_restarted_envs(info, dones, rb, step_data)
+            if restarted is not None:
+                player_state = player_init(mirror.current(), restarted, player_state)
+
             dones_idxes = np.nonzero(dones)[0].tolist()
             if dones_idxes:
                 # closing row for finished episodes (reference :639-657)
@@ -550,7 +581,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                 step_data["is_first"][:, dones_idxes] = 1
                 mask = np.zeros((num_envs,), bool)
                 mask[dones_idxes] = True
-                player_state = player_init(params, jnp.asarray(mask), player_state)
+                player_state = player_init(mirror.current(), mask, player_state)
 
             obs = next_obs
 
@@ -569,6 +600,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                     )
                 # metrics stay on device until log time — no per-step host sync
                 pending_metrics.append(metrics)
+                mirror.refresh({"wm": params["wm"], "actor": params["actor"]})
             if policy_step < total_steps:
                 # overlap the next sample + host→HBM transfer with the train
                 # step the device is computing right now
@@ -609,7 +641,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                 "rng": root_key,
             }
             if cfg.buffer.checkpoint:
-                ckpt_state["rb"] = rb.state_dict()
+                ckpt_state["rb"] = rb.checkpoint_state_dict()
             ckpt.save(policy_step, ckpt_state)
 
     envs.close()
@@ -617,13 +649,14 @@ def main(dist: Distributed, cfg: Config) -> None:
         test_cfg = Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}})
         test_env = vectorize(test_cfg, cfg.seed, rank, log_dir).envs[0]
         t_init, t_step = make_player(wm, actor, cfg, actions_dim, is_continuous, 1)
-        t_state = t_init(params)
+        t_params = jax.device_put({"wm": params["wm"], "actor": params["actor"]}, pdev)
+        t_state = t_init(t_params)
 
         def _step(o, s, k, greedy):
-            env_actions, _, s = t_step(params, o, s, k, greedy)
-            return env_actions, s
+            env_actions, _, s, k = t_step(t_params, o, s, k, greedy)
+            return env_actions, s, k
 
-        test(_step, t_state, test_env, cfg, log_dir, logger)
+        test(_step, t_state, test_env, cfg, log_dir, logger, device=pdev)
     if rank == 0 and not cfg.model_manager.disabled:
         from ...utils.model_manager import register_model
 
@@ -660,10 +693,12 @@ def evaluate_dreamer_v3(dist: Distributed, cfg: Config, state: Dict[str, Any]) -
         dist, cfg, env.observation_space, actions_dim, is_continuous, root_key, state["params"]
     )
     t_init, t_step = make_player(wm, actor, cfg, actions_dim, is_continuous, 1)
-    t_state = t_init(params)
+    pdev = player_device(cfg, dist.local_device)
+    t_params = jax.device_put({"wm": params["wm"], "actor": params["actor"]}, pdev)
+    t_state = t_init(t_params)
 
     def _step(o, s, k, greedy):
-        env_actions, _, s = t_step(params, o, s, k, greedy)
-        return env_actions, s
+        env_actions, _, s, k = t_step(t_params, o, s, k, greedy)
+        return env_actions, s, k
 
-    test(_step, t_state, env, cfg, log_dir, logger)
+    test(_step, t_state, env, cfg, log_dir, logger, device=pdev)

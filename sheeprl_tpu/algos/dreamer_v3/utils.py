@@ -60,14 +60,17 @@ def update_moments(
 
 def prepare_obs(
     obs: Dict[str, np.ndarray], cnn_keys=(), mlp_keys=(), num_envs: int = 1
-) -> Dict[str, jax.Array]:
-    """Host obs → device: images stay uint8 (normalized in the encoder path),
-    vectors f32 (reference dreamer_v3/utils.py prepare_obs)."""
-    out: Dict[str, jax.Array] = {}
+) -> Dict[str, np.ndarray]:
+    """Shape the host obs for the player: images stay uint8 (normalized in
+    the encoder path), vectors f32 (reference dreamer_v3/utils.py
+    prepare_obs). Stays numpy — the jitted player step transfers it to
+    wherever the player params are committed (parallel/placement.py), so no
+    eager device round trip happens here."""
+    out: Dict[str, np.ndarray] = {}
     for k in cnn_keys:
-        out[k] = jnp.asarray(np.asarray(obs[k]).reshape(num_envs, *np.asarray(obs[k]).shape[-3:]))
+        out[k] = np.asarray(obs[k]).reshape(num_envs, *np.asarray(obs[k]).shape[-3:])
     for k in mlp_keys:
-        out[k] = jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1))
+        out[k] = np.asarray(obs[k], np.float32).reshape(num_envs, -1)
     return out
 
 
@@ -75,21 +78,25 @@ def normalize_obs(obs: Dict[str, jax.Array], cnn_keys) -> Dict[str, jax.Array]:
     return {k: (v.astype(jnp.float32) / 255.0 - 0.5) if k in cnn_keys else v for k, v in obs.items()}
 
 
-def test(player_step, player_state, env, cfg, log_dir: str, logger=None, seed=None) -> float:
-    """Greedy episode with the device-resident player (reference utils.py test)."""
+def test(player_step, player_state, env, cfg, log_dir: str, logger=None, seed=None, device=None) -> float:
+    """Greedy episode with the recurrent player (reference utils.py test).
+    `player_step(obs, state, key, greedy) -> (actions, state, key)` threads
+    the PRNG key through the jitted step; `device` commits the initial key
+    next to the player params so no cross-device hop happens per frame."""
     done = False
     cumulative_rew = 0.0
     obs, _ = env.reset(seed=seed if seed is not None else cfg.seed)
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     key = jax.random.key(cfg.seed)
+    if device is not None:
+        key = jax.device_put(key, device)
     import gymnasium as gym
 
     is_box = isinstance(env.action_space, gym.spaces.Box)
     while not done:
-        device_obs = prepare_obs(obs, cnn_keys, mlp_keys, 1)
-        key, k = jax.random.split(key)
-        env_actions, player_state = player_step(device_obs, player_state, k, True)
+        host_obs = prepare_obs(obs, cnn_keys, mlp_keys, 1)
+        env_actions, player_state, key = player_step(host_obs, player_state, key, True)
         acts = np.asarray(env_actions)
         if is_box or isinstance(env.action_space, gym.spaces.MultiDiscrete):
             step_action = acts.reshape(env.action_space.shape)

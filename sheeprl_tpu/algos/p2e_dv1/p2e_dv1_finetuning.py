@@ -22,8 +22,9 @@ from ...config import Config, instantiate
 from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer, StagedPrefetcher
 from ...optim import clipped
 from ...parallel import Distributed
+from ...parallel.placement import make_param_mirror
 from ...utils.checkpoint import CheckpointManager
-from ...utils.env import episode_stats, vectorize
+from ...utils.env import episode_stats, patch_restarted_envs, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm
@@ -74,7 +75,9 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
         }
         actor_exploration_params = explo_state["params"]["actor_exploration"]
 
-    envs = vectorize(cfg, cfg.seed, rank, log_dir)
+    # crash-prone suites restart in place; the loop patches the buffer via
+    # patch_restarted_envs (reference dreamer_v3.py:385-399)
+    envs = vectorize(cfg, cfg.seed, rank, log_dir, restart_handled_by_loop=True)
     obs_space = envs.single_observation_space
     action_space = envs.single_action_space
     num_envs = int(cfg.env.num_envs)
@@ -158,8 +161,16 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
     prefetch = StagedPrefetcher(_host_sample, dist.sharding(None, None, "dp"))
     pending_metrics: list = []
 
+    def _sp():
+        if actor_type == "task":
+            return {"wm": params["wm"], "actor": params["actor"]}
+        return {"wm": params["wm"], "actor": actor_exploration_params}
+
+    # Actor/learner split (parallel/placement.py): see dreamer_v3.py
+    mirror, pdev, player_key, root_key = make_param_mirror(cfg, dist.local_device, _sp(), root_key)
+
     obs, _ = envs.reset(seed=cfg.seed)
-    player_state = player_init()
+    player_state = jax.device_put(player_init(), pdev)
 
     step_data: Dict[str, np.ndarray] = {}
     for k in obs_keys:
@@ -176,17 +187,12 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
             # the task actor takes over (reference :330-331)
             if policy_step >= learning_starts and actor_type != "task":
                 actor_type = "task"
-            device_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
-            root_key, k = jax.random.split(root_key)
+                mirror.refresh(_sp())
+            host_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
             expl_amount = expl_amount_at(policy_step)
             aggregator.update(f"Params/exploration_amount_{actor_type}", expl_amount)
-            step_params = (
-                params
-                if actor_type == "task"
-                else {"wm": params["wm"], "actor": actor_exploration_params, "critic": params["critic"]}
-            )
-            env_actions, actions_cat, player_state = player_step_fn(
-                step_params, device_obs, player_state, k, expl_amount=expl_amount
+            env_actions, actions_cat, player_state, player_key = player_step_fn(
+                mirror.current(), host_obs, player_state, player_key, expl_amount=expl_amount
             )
             actions_np = np.asarray(actions_cat)
             actions_env = np.asarray(env_actions)
@@ -218,13 +224,19 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
             step_data["rewards"] = clip_rewards_fn(
                 np.asarray(rewards, np.float32).reshape(1, num_envs, 1)
             )
+
+            # in-flight env restart → truncation boundary + fresh recurrent
+            # state (reference dreamer_v3.py:595-608 / patch_restarted_envs)
+            restarted = patch_restarted_envs(info, dones, rb, step_data)
+            if restarted is not None:
+                player_state = player_init(restarted, player_state)
             rb.add(step_data)
 
             dones_idxes = np.nonzero(dones)[0].tolist()
             if dones_idxes:
                 mask = np.zeros((num_envs,), bool)
                 mask[dones_idxes] = True
-                player_state = player_init(jnp.asarray(mask), player_state)
+                player_state = player_init(mask, player_state)
 
             obs = next_obs
 
@@ -241,6 +253,7 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
                         jax.random.split(sub, per_rank_gradient_steps),
                     )
                 pending_metrics.append(metrics)
+                mirror.refresh(_sp())
             if policy_step < total_steps:
                 prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
 
@@ -270,7 +283,7 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
                 "rng": root_key,
             }
             if cfg.buffer.checkpoint:
-                ckpt_state["rb"] = rb.state_dict()
+                ckpt_state["rb"] = rb.checkpoint_state_dict()
             ckpt.save(policy_step, ckpt_state)
 
     envs.close()
@@ -278,13 +291,14 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
         test_cfg = Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}})
         test_env = vectorize(test_cfg, cfg.seed, rank, log_dir).envs[0]
         t_init, t_step, _ = make_player(wm, actor, cfg, actions_dim, is_continuous, 1)
-        t_state = t_init()
+        t_params = jax.device_put({"wm": params["wm"], "actor": params["actor"]}, pdev)
+        t_state = jax.device_put(t_init(), pdev)
 
         def _step(o, s, k, greedy):
-            env_actions, _, s = t_step(params, o, s, k, greedy)
-            return env_actions, s
+            env_actions, _, s, k = t_step(t_params, o, s, k, greedy)
+            return env_actions, s, k
 
-        test(_step, t_state, test_env, cfg, log_dir, logger)
+        test(_step, t_state, test_env, cfg, log_dir, logger, device=pdev)
     if rank == 0 and not cfg.model_manager.disabled:
         from ...utils.model_manager import register_model
 

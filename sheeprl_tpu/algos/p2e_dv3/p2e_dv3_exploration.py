@@ -43,8 +43,9 @@ from ...distributions import (
 from ...ops import lambda_values as lambda_values_op
 from ...optim import clipped
 from ...parallel import Distributed
+from ...parallel.placement import make_param_mirror, player_device
 from ...utils.checkpoint import CheckpointManager
-from ...utils.env import episode_stats, vectorize
+from ...utils.env import episode_stats, patch_restarted_envs, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
@@ -494,7 +495,9 @@ def main(dist: Distributed, cfg: Config) -> None:
     if rank == 0:
         save_configs(cfg, log_dir)
 
-    envs = vectorize(cfg, cfg.seed, rank, log_dir)
+    # crash-prone suites restart in place; the loop patches the buffer via
+    # patch_restarted_envs (reference dreamer_v3.py:385-399)
+    envs = vectorize(cfg, cfg.seed, rank, log_dir, restart_handled_by_loop=True)
     obs_space = envs.single_observation_space
     action_space = envs.single_action_space
     num_envs = int(cfg.env.num_envs)
@@ -567,6 +570,10 @@ def main(dist: Distributed, cfg: Config) -> None:
     train = make_train_fn(wm, actor, critic, ens_apply, txs, cfg, is_continuous, actions_dim)
     actor_type = str(cfg.algo.player.actor_type)
     player_init, player_step_fn = make_player(wm, actor, cfg, actions_dim, is_continuous, num_envs)
+    # Actor/learner split (parallel/placement.py): see dreamer_v3.py
+    mirror, pdev, player_key, root_key = make_param_mirror(
+        cfg, dist.local_device, _player_params(params, actor_type), root_key
+    )
 
     # per-critic exploration metrics are config-driven (one entry per critic)
     aggregator_keys = AGGREGATOR_KEYS | {
@@ -597,7 +604,7 @@ def main(dist: Distributed, cfg: Config) -> None:
     pending_metrics: list = []
 
     obs, _ = envs.reset(seed=cfg.seed)
-    player_state = player_init(_player_params(params, actor_type))
+    player_state = player_init(mirror.params)
 
     step_data: Dict[str, np.ndarray] = {}
     for k in obs_keys:
@@ -621,10 +628,9 @@ def main(dist: Distributed, cfg: Config) -> None:
                         oh.append(np.eye(adim, dtype=np.float32)[acts2d[:, j]])
                     actions_np = np.concatenate(oh, axis=-1)
             else:
-                device_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
-                root_key, k = jax.random.split(root_key)
-                env_actions, actions_cat, player_state = player_step_fn(
-                    _player_params(params, actor_type), device_obs, player_state, k
+                host_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
+                env_actions, actions_cat, player_state, player_key = player_step_fn(
+                    mirror.current(), host_obs, player_state, player_key
                 )
                 actions_np = np.asarray(actions_cat)
                 actions_env = np.asarray(env_actions)
@@ -660,6 +666,12 @@ def main(dist: Distributed, cfg: Config) -> None:
                 np.asarray(rewards, np.float32).reshape(1, num_envs, 1)
             )
 
+            # in-flight env restart → truncation boundary + fresh recurrent
+            # state (reference dreamer_v3.py:595-608 / patch_restarted_envs)
+            restarted = patch_restarted_envs(info, dones, rb, step_data)
+            if restarted is not None:
+                player_state = player_init(mirror.current(), restarted, player_state)
+
             dones_idxes = np.nonzero(dones)[0].tolist()
             if dones_idxes:
                 reset_data: Dict[str, np.ndarray] = {}
@@ -677,9 +689,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                 step_data["is_first"][:, dones_idxes] = 1
                 mask = np.zeros((num_envs,), bool)
                 mask[dones_idxes] = True
-                player_state = player_init(
-                    _player_params(params, actor_type), jnp.asarray(mask), player_state
-                )
+                player_state = player_init(mirror.current(), mask, player_state)
 
             obs = next_obs
 
@@ -697,6 +707,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                         jax.random.split(sub, per_rank_gradient_steps),
                     )
                 pending_metrics.append(metrics)
+                mirror.refresh(_player_params(params, actor_type))
             if policy_step < total_steps:
                 prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
 
@@ -735,7 +746,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                 "rng": root_key,
             }
             if cfg.buffer.checkpoint:
-                ckpt_state["rb"] = rb.state_dict()
+                ckpt_state["rb"] = rb.checkpoint_state_dict()
             ckpt.save(policy_step, ckpt_state)
 
     envs.close()
@@ -744,13 +755,14 @@ def main(dist: Distributed, cfg: Config) -> None:
         test_cfg = Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}})
         test_env = vectorize(test_cfg, cfg.seed, rank, log_dir).envs[0]
         t_init, t_step = make_player(wm, actor, cfg, actions_dim, is_continuous, 1)
-        t_state = t_init(_player_params(params, "task"))
+        t_params = jax.device_put(_player_params(params, "task"), pdev)
+        t_state = t_init(t_params)
 
         def _step(o, s, k, greedy):
-            env_actions, _, s = t_step(_player_params(params, "task"), o, s, k, greedy)
-            return env_actions, s
+            env_actions, _, s, k = t_step(t_params, o, s, k, greedy)
+            return env_actions, s, k
 
-        test(_step, t_state, test_env, cfg, log_dir, logger)
+        test(_step, t_state, test_env, cfg, log_dir, logger, device=pdev)
     if rank == 0 and not cfg.model_manager.disabled:
         from ...utils.model_manager import register_model
 
@@ -806,10 +818,12 @@ def evaluate_p2e_dv3(dist: Distributed, cfg: Config, state: Dict[str, Any]) -> N
         },
     )
     t_init, t_step = make_player(wm, actor, cfg, actions_dim, is_continuous, 1)
-    t_state = t_init(params)
+    pdev = player_device(cfg, dist.local_device)
+    t_params = jax.device_put(params, pdev)
+    t_state = t_init(t_params)
 
     def _step(o, s, k, greedy):
-        env_actions, _, s = t_step(params, o, s, k, greedy)
-        return env_actions, s
+        env_actions, _, s, k = t_step(t_params, o, s, k, greedy)
+        return env_actions, s, k
 
-    test(_step, t_state, env, cfg, log_dir, logger)
+    test(_step, t_state, env, cfg, log_dir, logger, device=pdev)

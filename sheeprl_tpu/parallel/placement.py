@@ -1,0 +1,119 @@
+"""Latency-aware actor/learner placement.
+
+The reference runs player and trainer on the same torch device (e.g.
+sheeprl/algos/dreamer_v3/dreamer_v3.py builds PlayerDV3 on ``fabric.device``)
+— fine when the accelerator sits on the local PCIe bus. A TPU often does not:
+it is reached over a network link where every dispatch+fetch round trip costs
+tens of milliseconds, while the per-env-step policy forward of a small net is
+microseconds of compute. Serving single-env inference from the remote chip
+makes the *latency*, not the FLOPs, the frame-rate.
+
+So the framework splits the loop (Podracer/Sebulba-style actor–learner
+placement, re-derived for a single-controller JAX process):
+
+* the **learner** (the big fused gradient-step program) stays on the
+  accelerator mesh, fed by the staged host→HBM prefetcher;
+* the **player** (per-step policy inference + recurrent state) runs on the
+  host CPU backend of the *same* process — same weights, same jitted code,
+  compiled for ``cpu`` simply by committing its inputs there;
+* a :class:`ParamMirror` keeps the player's copy of the weights in sync,
+  refreshed after every train burst (parameters only change there).
+
+The mirror has two refresh modes:
+
+* ``blocking`` (default) — the next player step waits for the new weights:
+  exactly the reference's always-latest-params semantics;
+* ``async`` — the device→host transfer is dispatched immediately but the
+  player keeps using the previous weights until the new ones have landed
+  (``jax.Array.is_ready``), hiding the link latency entirely. Staleness is
+  bounded by one transfer (a few env steps); standard practice in
+  distributed actor–learner RL (IMPALA-family).
+
+Configured per-run via ``algo.player.device`` (auto | host | accelerator)
+and ``algo.player.async_refresh``.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+
+def host_device() -> Any:
+    """The CPU backend device of this process (falls back to the default
+    device when JAX was initialized with a cpu-only platform)."""
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        return jax.local_devices()[0]
+
+
+def player_device(cfg: Any, accelerator: Optional[Any] = None) -> Any:
+    """Resolve where per-step policy inference should run.
+
+    ``auto`` places the player on the host CPU backend whenever the default
+    backend is an accelerator (remote dispatch latency ≫ tiny-net compute),
+    and on the default device when the process is CPU-only (tests, dryruns —
+    there is nothing to win and one device fewer to think about).
+    """
+    mode = "auto"
+    if cfg is not None:
+        mode = cfg.select("algo.player.device", "auto") or "auto"
+    default = accelerator if accelerator is not None else jax.local_devices()[0]
+    if mode == "accelerator":
+        return default
+    if mode == "host":
+        return host_device()
+    if mode != "auto":
+        raise ValueError(f"algo.player.device must be auto|host|accelerator, got '{mode}'")
+    return host_device() if default.platform != "cpu" else default
+
+
+class ParamMirror:
+    """Player-side copy of (a subtree of) the learner params.
+
+    ``refresh(new)`` dispatches the device→host transfer (async under JAX's
+    dispatch model); ``current()`` returns the params the player should use
+    this step. In blocking mode that is always the newest copy (the player
+    step then waits on the transfer); in async mode the newest copy is
+    swapped in only once every leaf ``is_ready()``, so the player never
+    stalls on the link.
+    """
+
+    def __init__(self, params: Any, device: Any, async_refresh: bool = False):
+        self.device = device
+        self.async_refresh = bool(async_refresh)
+        self.params = jax.device_put(params, device)
+        self._pending: Optional[Any] = None
+
+    def refresh(self, params: Any) -> None:
+        new = jax.device_put(params, self.device)
+        if self.async_refresh:
+            self._pending = new
+        else:
+            self.params = new
+
+    def current(self) -> Any:
+        if self._pending is not None:
+            try:
+                ready = all(x.is_ready() for x in jax.tree.leaves(self._pending))
+            except AttributeError:  # non-Array leaves: treat as ready
+                ready = True
+            if ready:
+                self.params, self._pending = self._pending, None
+        return self.params
+
+def make_param_mirror(cfg: Any, accelerator: Any, params: Any, root_key: Any):
+    """The per-algorithm player setup, in one place: resolve the player
+    device, mirror the player's param subtree there, and derive a player PRNG
+    key committed next to it (so the env loop never does a host-side split).
+
+    Returns ``(mirror, pdev, player_key, root_key)`` — the new ``root_key``
+    replaces the caller's (one split is consumed).
+    """
+    pdev = player_device(cfg, accelerator)
+    mirror = ParamMirror(
+        params, pdev, async_refresh=bool(cfg.select("algo.player.async_refresh", False))
+    )
+    root_key, pk = jax.random.split(root_key)
+    return mirror, pdev, jax.device_put(pk, pdev), root_key
