@@ -284,7 +284,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                 "rng": root_key,
             }
             if cfg.buffer.checkpoint:
-                ckpt_state["rb"] = rb.state_dict()
+                ckpt_state["rb"] = rb.checkpoint_state_dict()
             ckpt.save(policy_step, ckpt_state)
 
     envs.close()

@@ -283,7 +283,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                     "rng": root_key,
                 }
                 if cfg.buffer.checkpoint and rb is not None:
-                    ckpt_state["rb"] = rb.state_dict()
+                    ckpt_state["rb"] = rb.checkpoint_state_dict()
                 ckpt.save(policy_step, ckpt_state)
 
             params_q.put(params["actor"])
@@ -307,7 +307,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             "rng": root_key,
         }
         if cfg.buffer.checkpoint and rb is not None:
-            ckpt_state["rb"] = rb.state_dict()
+            ckpt_state["rb"] = rb.checkpoint_state_dict()
         ckpt.save(policy_step, ckpt_state)
 
     if cfg.algo.run_test:

@@ -225,6 +225,19 @@ class ReplayBuffer:
             "full": self._full,
         }
 
+    def checkpoint_state_dict(self) -> Dict[str, Any]:
+        """State for a *resumable* checkpoint. The env state is not saved, so
+        the row at the current write position is marked truncated — a resumed
+        sequential sample can then never treat the pre-save tail and the
+        post-resume head as one continuous trajectory (reference
+        CheckpointCallback._ckpt_rb, sheeprl/utils/callback.py:87-121).
+        Non-mutating: the surgery happens on the copied state, the live
+        buffer keeps its true flags."""
+        state = self.state_dict()
+        if "truncated" in state["buffer"] and (self._full or self._pos > 0):
+            state["buffer"]["truncated"][(state["pos"] - 1) % self._buffer_size, :] = 1
+        return state
+
     def load_state_dict(self, state: Dict[str, Any]) -> "ReplayBuffer":
         for k, v in state["buffer"].items():
             self._maybe_create(k, v.shape[2:], v.dtype)
@@ -410,6 +423,22 @@ class EnvIndependentReplayBuffer:
     def state_dict(self) -> Dict[str, Any]:
         return {"buffers": [b.state_dict() for b in self._buffers]}
 
+    def checkpoint_state_dict(self) -> Dict[str, Any]:
+        """Per-env truncated-flag surgery at each sub-buffer's write position
+        (reference callback.py:112-116); see ReplayBuffer.checkpoint_state_dict."""
+        return {"buffers": [b.checkpoint_state_dict() for b in self._buffers]}
+
+    def mark_restart(self, env_idx: int) -> None:
+        """After an in-flight env restart (RestartOnException fired without a
+        real episode end), rewrite that env's last inserted row as a
+        truncation boundary: terminated←0, truncated←1, is_first←0
+        (reference dreamer_v3.py:595-608)."""
+        b = self._buffers[env_idx]
+        idx = (b._pos - 1) % b.buffer_size
+        for key, value in (("terminated", 0), ("truncated", 1), ("is_first", 0)):
+            if key in b:
+                b[key][idx] = value
+
     def load_state_dict(self, state: Dict[str, Any]) -> "EnvIndependentReplayBuffer":
         for b, s in zip(self._buffers, state["buffers"]):
             b.load_state_dict(s)
@@ -578,6 +607,14 @@ class EpisodeBuffer:
             ],
             "cum_len": self._cum_len,
         }
+
+    def checkpoint_state_dict(self) -> Dict[str, Any]:
+        """Open (unfinished) episodes are dropped from the saved state: the
+        env they belong to is not checkpointed, so they could never be closed
+        after a resume (reference callback.py:117-121)."""
+        state = self.state_dict()
+        state["open"] = [None for _ in state["open"]]
+        return state
 
     def load_state_dict(self, state: Dict[str, Any]) -> "EpisodeBuffer":
         self._episodes = state["episodes"]
