@@ -1,3 +1,3 @@
-from .dummy import ContinuousDummyEnv, DiscreteDummyEnv, MultiDiscreteDummyEnv
+from .dummy import ContinuousDummyEnv, CrashingDummyEnv, DiscreteDummyEnv, MultiDiscreteDummyEnv
 
-__all__ = ["ContinuousDummyEnv", "DiscreteDummyEnv", "MultiDiscreteDummyEnv"]
+__all__ = ["ContinuousDummyEnv", "CrashingDummyEnv", "DiscreteDummyEnv", "MultiDiscreteDummyEnv"]

@@ -82,3 +82,20 @@ class MultiDiscreteDummyEnv(BaseDummyEnv):
     def __init__(self, action_dims: Optional[List[int]] = None, **kwargs: Any):
         self.action_space = gym.spaces.MultiDiscrete(action_dims or [2, 2])
         super().__init__(**kwargs)
+
+
+class CrashingDummyEnv(DiscreteDummyEnv):
+    """Discrete dummy that raises mid-episode every `crash_every` cumulative
+    steps — drives the fault-tolerance path (RestartOnException + buffer
+    restart surgery, reference dreamer_v3.py:385-399, :595-608)."""
+
+    def __init__(self, crash_every: int = 3, **kwargs: Any):
+        super().__init__(**kwargs)
+        self._crash_every = int(crash_every)
+        self._lifetime_steps = 0
+
+    def step(self, action: Any):
+        self._lifetime_steps += 1
+        if self._lifetime_steps % self._crash_every == 0:
+            raise RuntimeError(f"scripted crash at lifetime step {self._lifetime_steps}")
+        return super().step(action)

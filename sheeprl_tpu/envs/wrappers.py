@@ -74,15 +74,33 @@ class ActionRepeat(gym.Wrapper):
 class RestartOnException(gym.Wrapper):
     """Re-create a crashed env, with a failure budget inside a sliding window
     (reference wrappers.py:74-123) — used because MineRL/Diambra crash in
-    practice. On step failure, returns a zeroed obs with truncated=True and
-    `info["restart_on_exception"]=True` so train loops can patch buffers
-    (reference dreamer_v3.py:595-608)."""
+    practice. Two reporting modes for the crash step:
 
-    def __init__(self, env_fn, exceptions: Tuple = (Exception,), window: float = 300.0, maxfails: int = 2):
+    * ``report_truncated=True`` (safe default): the crash is reported as an
+      ordinary truncation — correct with ANY train loop, no cooperation
+      needed (the episode simply ends at the crash row).
+    * ``report_truncated=False`` (reference dreamer_v3 semantics,
+      wrappers.py:103): terminated=False, truncated=False plus
+      `info["restart_on_exception"]=True` and the post-restart reset obs;
+      ONLY for loops that rewrite their replay buffer so the crash row
+      becomes a truncation boundary (reference dreamer_v3.py:595-608 /
+      EnvIndependentReplayBuffer.mark_restart here)."""
+
+    def __init__(
+        self,
+        env_fn,
+        exceptions: Tuple = (Exception,),
+        window: float = 300.0,
+        maxfails: int = 2,
+        wait: float = 0.0,
+        report_truncated: bool = True,
+    ):
         self._env_fn = env_fn
-        self._exceptions = exceptions
+        self._exceptions = tuple(exceptions) if isinstance(exceptions, (tuple, list)) else (exceptions,)
         self._window = window
         self._maxfails = maxfails
+        self._wait = wait
+        self._report_truncated = bool(report_truncated)
         self._fails = 0
         self._last_fail_time = 0.0
         super().__init__(env_fn())
@@ -100,6 +118,8 @@ class RestartOnException(gym.Wrapper):
             self.env.close()
         except Exception:
             pass
+        if self._wait:
+            time.sleep(self._wait)
         self.env = self._env_fn()
 
     def reset(self, **kwargs: Any):
@@ -108,6 +128,13 @@ class RestartOnException(gym.Wrapper):
                 return self.env.reset(**kwargs)
             except self._exceptions:
                 self._restart()
+                try:
+                    obs, info = self.env.reset(**kwargs)
+                except self._exceptions:
+                    continue
+                info = dict(info)
+                info["restart_on_exception"] = True
+                return obs, info
         raise RuntimeError("Unreachable")
 
     def step(self, action: Any):
@@ -118,7 +145,7 @@ class RestartOnException(gym.Wrapper):
             obs, info = self.env.reset()
             info = dict(info)
             info["restart_on_exception"] = True
-            return obs, 0.0, False, True, info
+            return obs, 0.0, False, self._report_truncated, info
 
 
 class FrameStack(gym.Wrapper):
@@ -259,7 +286,7 @@ class ActionsAsObservationWrapper(gym.Wrapper):
             if isinstance(env.observation_space, spaces.Dict)
             else {"obs": env.observation_space}
         )
-        obs_spaces["action"] = spaces.Box(-np.inf, np.inf, (self._per_action * num_stack,), np.float32)
+        obs_spaces["action_stack"] = spaces.Box(-np.inf, np.inf, (self._per_action * num_stack,), np.float32)
         self.observation_space = spaces.Dict(obs_spaces)
 
     def _action_vec(self, action: Any) -> np.ndarray:
@@ -281,8 +308,8 @@ class ActionsAsObservationWrapper(gym.Wrapper):
         stacked = list(self._actions)[self._dilation - 1 :: self._dilation][-self._num_stack :]
         action_obs = np.concatenate(stacked).astype(np.float32)
         if isinstance(obs, dict):
-            return {**obs, "action": action_obs}
-        return {"obs": obs, "action": action_obs}
+            return {**obs, "action_stack": action_obs}
+        return {"obs": obs, "action_stack": action_obs}
 
     def reset(self, **kwargs: Any):
         obs, info = self.env.reset(**kwargs)

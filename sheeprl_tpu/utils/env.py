@@ -26,6 +26,7 @@ from ..envs.wrappers import (
     FrameStack,
     GrayscaleRenderWrapper,
     MaskVelocityWrapper,
+    RestartOnException,
     RewardAsObservationWrapper,
 )
 
@@ -238,6 +239,28 @@ def make_env(
     return thunk
 
 
+def patch_restarted_envs(info, dones, rb, step_data: Optional[Dict[str, Any]] = None):
+    """Shared loop-side half of the fault-tolerance contract (reference
+    dreamer_v3.py:595-608): for every env that restarted in flight (crash
+    without a real episode end), rewrite its last replay row as a truncation
+    boundary and flag the incoming row `is_first`. Returns the boolean mask
+    of restarted envs (for the caller to reset its recurrent player state),
+    or None when nothing restarted."""
+    roe = info.get("restart_on_exception")
+    if roe is None:
+        return None
+    restarted = np.asarray(roe).reshape(-1).astype(bool)
+    restarted &= ~np.asarray(dones).reshape(-1).astype(bool)
+    if not restarted.any():
+        return None
+    for i in np.nonzero(restarted)[0]:
+        if hasattr(rb, "mark_restart"):  # episode buffers rely on is_first alone
+            rb.mark_restart(int(i))
+        if step_data is not None and "is_first" in step_data:
+            step_data["is_first"][0, i] = 1
+    return restarted
+
+
 def episode_stats(info: Dict[str, Any]):
     """Yield (reward, length) for every env that finished an episode this step
     (gymnasium ≥1.0 dict-of-arrays `final_info` format)."""
@@ -253,8 +276,15 @@ def episode_stats(info: Dict[str, Any]):
 
 
 def get_dummy_env(id: str) -> gym.Env:
-    from ..envs.dummy import ContinuousDummyEnv, DiscreteDummyEnv, MultiDiscreteDummyEnv
+    from ..envs.dummy import (
+        ContinuousDummyEnv,
+        CrashingDummyEnv,
+        DiscreteDummyEnv,
+        MultiDiscreteDummyEnv,
+    )
 
+    if "crashing" in id:
+        return CrashingDummyEnv()
     if "continuous" in id:
         return ContinuousDummyEnv()
     if "multidiscrete" in id:
@@ -264,13 +294,48 @@ def get_dummy_env(id: str) -> gym.Env:
     raise ValueError(f"Unrecognized dummy environment: {id}")
 
 
-def vectorize(cfg: Config, seed: int, rank: int, run_name: Optional[str] = None, prefix: str = ""):
+def vectorize(
+    cfg: Config,
+    seed: int,
+    rank: int,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+    restart_handled_by_loop: bool = False,
+):
     """Build the vector env the reference builds inline in every algo main
-    (e.g. ppo.py:137-150)."""
+    (e.g. ppo.py:137-150).
+
+    Fault tolerance (reference dreamer_v3.py:385-399): envs of the
+    crash-prone suites (MineRL/DIAMBRA/MineDojo — detected from
+    `env.wrapper._target_`) are wrapped in RestartOnException, so a crashed
+    env is re-created in place; `env.restart_on_exception` forces the wrap
+    on/off for any suite, and `env.restart_window` / `env.restart_maxfails` /
+    `env.restart_wait` size the failure budget. By default the crash step is
+    reported as an ordinary truncation (safe with any train loop); a loop
+    that instead patches its replay buffer on `info["restart_on_exception"]`
+    (the Dreamer family, reference :595-608, `patch_restarted_envs` here)
+    passes `restart_handled_by_loop=True` to get the reference's
+    not-an-episode-end semantics."""
     thunks = [
         make_env(cfg, seed + rank * cfg.env.num_envs + i, rank, run_name, prefix, vector_env_idx=i)
         for i in range(cfg.env.num_envs)
     ]
+    env_target = str(cfg.select("env.wrapper._target_") or "").lower()
+    crash_prone = any(s in env_target for s in ("minerl", "diambra", "minedojo"))
+    if bool(cfg.env.get("restart_on_exception", crash_prone)):
+        from functools import partial
+
+        thunks = [
+            partial(
+                RestartOnException,
+                thunk,
+                window=float(cfg.env.get("restart_window", 300.0)),
+                maxfails=int(cfg.env.get("restart_maxfails", 2)),
+                wait=float(cfg.env.get("restart_wait", 0.0)),
+                report_truncated=not restart_handled_by_loop,
+            )
+            for thunk in thunks
+        ]
     # SAME_STEP autoreset = the gymnasium-0.29 semantics the reference train
     # loops assume: reset obs returned at the done step, true final obs in
     # info["final_obs"].

@@ -1,0 +1,92 @@
+"""Fault tolerance: RestartOnException + replay-buffer restart surgery
+(reference wrappers.py:74-123 wiring in dreamer_v3.py:385-399, :595-608)."""
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.envs.dummy import CrashingDummyEnv, DiscreteDummyEnv
+from sheeprl_tpu.envs.wrappers import RestartOnException
+
+
+def test_restart_on_exception_recreates_env_and_flags_info():
+    # reference dreamer_v3 semantics: a crash-restart is not an episode end
+    # (reference wrappers.py:103) — requires a loop that patches the buffer
+    env = RestartOnException(
+        lambda: CrashingDummyEnv(crash_every=3), window=300.0, maxfails=10, report_truncated=False
+    )
+    env.reset()
+    flagged = 0
+    for _ in range(8):
+        obs, reward, terminated, truncated, info = env.step(0)
+        if info.get("restart_on_exception"):
+            flagged += 1
+            assert not terminated and not truncated
+            assert reward == 0.0
+    assert flagged >= 2  # crashed (and recovered) at lifetime steps 3 and 6
+
+
+def test_restart_on_exception_safe_default_reports_truncation():
+    # default mode: correct with ANY train loop — the crash ends the episode
+    env = RestartOnException(lambda: CrashingDummyEnv(crash_every=3), window=300.0, maxfails=10)
+    env.reset()
+    obs, reward, terminated, truncated, info = env.step(0)
+    obs, reward, terminated, truncated, info = env.step(0)
+    obs, reward, terminated, truncated, info = env.step(0)  # lifetime step 3: crash
+    assert info.get("restart_on_exception")
+    assert truncated and not terminated
+
+
+def test_restart_on_exception_budget_exceeded_raises():
+    def make():
+        e = CrashingDummyEnv(crash_every=1)  # crashes every step
+        return e
+
+    env = RestartOnException(make, window=300.0, maxfails=2)
+    env.reset()
+    with pytest.raises(RuntimeError, match="crashed too many times"):
+        for _ in range(5):
+            env.step(0)
+
+
+def test_mark_restart_rewrites_last_row_as_truncation_boundary():
+    rb = EnvIndependentReplayBuffer(16, n_envs=2, buffer_cls=SequentialReplayBuffer)
+    t = 3
+    rb.add(
+        {
+            "obs": np.zeros((t, 2, 1), np.float32),
+            "terminated": np.ones((t, 2, 1), np.float32),
+            "truncated": np.zeros((t, 2, 1), np.float32),
+            "is_first": np.ones((t, 2, 1), np.float32),
+        }
+    )
+    rb.mark_restart(1)
+    b0, b1 = rb._buffers
+    # env 1's last row is rewritten, env 0 untouched
+    assert b1["terminated"][2, 0, 0] == 0
+    assert b1["truncated"][2, 0, 0] == 1
+    assert b1["is_first"][2, 0, 0] == 0
+    assert b0["terminated"][2, 0, 0] == 1
+    assert b0["truncated"][2, 0, 0] == 0
+
+
+def test_dreamer_v3_crash_then_continue(standard_args):
+    """End-to-end: DV3 trains through scripted env crashes without dying —
+    the RestartOnException wrap is applied by vectorize() and the loop
+    patches the buffer (VERDICT round 2, next-round item #6)."""
+    run(
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=crashing_dummy",
+            "env.restart_on_exception=True",
+            "algo=dreamer_v3_XS",
+            "algo.per_rank_batch_size=2",
+            "algo.per_rank_sequence_length=2",
+            "algo.learning_starts=0",
+            "algo.horizon=4",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+        + standard_args
+    )
