@@ -1,0 +1,83 @@
+"""CLI behavior round trips (VERDICT round 2, next-round item #5) — the
+analogue of reference tests/test_algos/test_cli.py: resume continues the
+counters (:121-165), eval rebuilds the run from the saved config (:277+),
+registration populates the model registry, and mismatches error early."""
+import glob
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import evaluation, registration, run
+from sheeprl_tpu.utils.checkpoint import CheckpointManager
+
+PPO_ARGS = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.encoder.cnn_features_dim=16",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.total_steps=64",
+    "buffer.memmap=False",
+    "metric.log_level=0",
+    "checkpoint.every=32",
+]
+
+
+def _latest_ckpt() -> str:
+    ckpts = sorted(
+        glob.glob("logs/runs/ppo/discrete_dummy/*/version_*/checkpoint/ckpt_*.ckpt"),
+        key=lambda p: (p, int(os.path.basename(p).split("_")[1].split(".")[0])),
+    )
+    assert ckpts, "no checkpoint produced"
+    return ckpts[-1]
+
+
+@pytest.fixture()
+def trained_ckpt():
+    run(PPO_ARGS)
+    return _latest_ckpt()
+
+
+def test_resume_continues_counters(trained_ckpt):
+    start = CheckpointManager.load(trained_ckpt)
+    assert start["policy_step"] > 0
+    run(PPO_ARGS + [f"checkpoint.resume_from={trained_ckpt}", "algo.total_steps=128"])
+    resumed = CheckpointManager.load(_latest_ckpt())
+    # the resumed run picked the counters up, did more work, and saved again
+    assert resumed["policy_step"] > start["policy_step"]
+    assert resumed["update"] > start["update"]
+
+
+def test_resume_env_mismatch_errors(trained_ckpt):
+    with pytest.raises(ValueError, match="Cannot resume"):
+        run(PPO_ARGS + [f"checkpoint.resume_from={trained_ckpt}", "env.id=continuous_dummy"])
+
+
+def test_eval_round_trip(trained_ckpt):
+    # rebuilds the run config from the checkpoint's saved config.yaml and
+    # plays a greedy episode — must not need any of the original CLI args
+    evaluation([f"checkpoint_path={trained_ckpt}"])
+
+
+def test_eval_missing_checkpoint_errors():
+    with pytest.raises(FileNotFoundError):
+        evaluation(["checkpoint_path=logs/nope/ckpt_1.ckpt"])
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        evaluation([])
+
+
+def test_registration_populates_registry(trained_ckpt):
+    registration([f"checkpoint_path={trained_ckpt}"])
+    entries = glob.glob("models_registry/ppo_discrete_dummy*/v1/params.pkl")
+    assert entries, "registration wrote no model registry entry"
+    metas = glob.glob("models_registry/ppo_discrete_dummy*/v1/meta.json")
+    assert metas

@@ -1,0 +1,68 @@
+"""Coupled DP paths on the virtual CPU mesh (VERDICT round 2, next-round
+item #4): the `devices` fixture (conftest.py) runs ppo, sac and dreamer_v3
+end-to-end at fabric.devices ∈ {1, 2} — the analogue of the reference's
+LT_DEVICES gloo-spawn matrix (reference tests/conftest.py:16-18)."""
+import pytest
+
+from sheeprl_tpu.cli import run
+
+
+def _run(args, standard_args):
+    run(args + standard_args)
+
+
+def test_ppo_dp(standard_args, devices):
+    _run(
+        [
+            "exp=ppo",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            f"fabric.devices={devices}",
+            "env.num_envs=2",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.encoder.cnn_features_dim=16",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+        ],
+        standard_args,
+    )
+
+
+def test_sac_dp(standard_args, devices):
+    _run(
+        [
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            f"fabric.devices={devices}",
+            "algo.per_rank_batch_size=4",
+            "algo.hidden_size=8",
+            "algo.learning_starts=0",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.size=64",
+        ],
+        standard_args,
+    )
+
+
+def test_dreamer_v3_dp(standard_args, devices):
+    _run(
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            f"fabric.devices={devices}",
+            "algo=dreamer_v3_XS",
+            "algo.per_rank_batch_size=2",
+            "algo.per_rank_sequence_length=2",
+            "algo.learning_starts=0",
+            "algo.horizon=4",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+        ],
+        standard_args,
+    )
