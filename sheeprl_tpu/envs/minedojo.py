@@ -1,16 +1,21 @@
-"""MineDojo adapter (reference sheeprl/envs/minedojo.py, 344 LoC).
+"""MineDojo adapter.
 
-Wraps `minedojo.make` (ARNN action space) into the 3-head MultiDiscrete
-action space the Dreamer Minedojo actor consumes —
+Behavioral spec from reference sheeprl/envs/minedojo.py (344 LoC), re-written
+in this repo's idiom: wraps `minedojo.make` (ARNN action space) into the
+3-head MultiDiscrete action space the Dreamer MineDojo actor consumes —
 [action_type(19), craft_item, inventory_slot] — with:
 
-* a 19-entry action map over movement/camera/functional actions;
-* sticky attack/jump counters (attack repeats for `sticky_attack` steps,
-  jump for `sticky_jump`, cancelled when a conflicting action is chosen);
-* pitch clamped to `pitch_limits` (camera action suppressed at the limits);
+* a 19-entry action table over movement/camera/functional actions;
+* sticky attack/jump (attack keeps firing for `sticky_attack` steps, jump
+  for `sticky_jump`, cancelled by a conflicting choice);
+* pitch clamped to `pitch_limits` (the camera bin is suppressed at a limit);
 * observation dict {rgb, inventory, inventory_max, inventory_delta,
   equipment, life_stats, mask_action_type, mask_equip_place, mask_destroy,
   mask_craft_smelt} — the masks gate the actor's heads.
+
+The action table, observation-space fields and mask semantics are the parity
+contract (they must match the reference's Dreamer-MineDojo actor); the
+control flow here is this repo's own.
 """
 from __future__ import annotations
 
@@ -20,7 +25,7 @@ if not _IS_MINEDOJO_AVAILABLE:
     raise ModuleNotFoundError(str(_IS_MINEDOJO_AVAILABLE))
 
 import copy
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import gymnasium as gym
 import minedojo
@@ -29,36 +34,57 @@ import numpy as np
 from minedojo.sim import ALL_CRAFT_SMELT_ITEMS, ALL_ITEMS
 
 N_ALL_ITEMS = len(ALL_ITEMS)
-# rows: [move, strafe, jump/sneak/sprint, pitch, yaw, functional, craft, slot]
-# camera indices are 15°-binned with 12 = no-op (reference minedojo.py:20-41)
-ACTION_MAP = {
-    0: np.array([0, 0, 0, 12, 12, 0, 0, 0]),  # no-op
-    1: np.array([1, 0, 0, 12, 12, 0, 0, 0]),  # forward
-    2: np.array([2, 0, 0, 12, 12, 0, 0, 0]),  # back
-    3: np.array([0, 1, 0, 12, 12, 0, 0, 0]),  # left
-    4: np.array([0, 2, 0, 12, 12, 0, 0, 0]),  # right
-    5: np.array([1, 0, 1, 12, 12, 0, 0, 0]),  # jump + forward
-    6: np.array([1, 0, 2, 12, 12, 0, 0, 0]),  # sneak + forward
-    7: np.array([1, 0, 3, 12, 12, 0, 0, 0]),  # sprint + forward
-    8: np.array([0, 0, 0, 11, 12, 0, 0, 0]),  # pitch down (-15)
-    9: np.array([0, 0, 0, 13, 12, 0, 0, 0]),  # pitch up (+15)
-    10: np.array([0, 0, 0, 12, 11, 0, 0, 0]),  # yaw down (-15)
-    11: np.array([0, 0, 0, 12, 13, 0, 0, 0]),  # yaw up (+15)
-    12: np.array([0, 0, 0, 12, 12, 1, 0, 0]),  # use
-    13: np.array([0, 0, 0, 12, 12, 2, 0, 0]),  # drop
-    14: np.array([0, 0, 0, 12, 12, 3, 0, 0]),  # attack
-    15: np.array([0, 0, 0, 12, 12, 4, 0, 0]),  # craft
-    16: np.array([0, 0, 0, 12, 12, 5, 0, 0]),  # equip
-    17: np.array([0, 0, 0, 12, 12, 6, 0, 0]),  # place
-    18: np.array([0, 0, 0, 12, 12, 7, 0, 0]),  # destroy
+
+# ARNN action vector slots
+_MOVE, _STRAFE, _BODY, _PITCH, _YAW, _FN, _CRAFT_ARG, _SLOT_ARG = range(8)
+# camera bins are 15°; bin 12 = hold still
+_CAM_NOOP, _CAM_DOWN, _CAM_UP = 12, 11, 13
+# functional-slot values
+_FN_NOOP, _FN_USE, _FN_DROP, _FN_ATTACK, _FN_CRAFT, _FN_EQUIP, _FN_PLACE, _FN_DESTROY = range(8)
+_FN_NEEDS_SLOT = (_FN_EQUIP, _FN_PLACE, _FN_DESTROY)
+_BODY_JUMP = 1
+
+
+def _arnn(move=0, strafe=0, body=0, pitch=_CAM_NOOP, yaw=_CAM_NOOP, fn=_FN_NOOP) -> np.ndarray:
+    """One row of the 8-slot ARNN action vector (craft/slot args filled at
+    dispatch time)."""
+    return np.array([move, strafe, body, pitch, yaw, fn, 0, 0])
+
+
+# The 19 macro-actions of the Dreamer MineDojo actor (parity table:
+# reference minedojo.py:20-41 — same index → same primitive action).
+ACTION_MAP: Dict[int, np.ndarray] = {
+    0: _arnn(),                      # no-op
+    1: _arnn(move=1),                # forward
+    2: _arnn(move=2),                # back
+    3: _arnn(strafe=1),              # left
+    4: _arnn(strafe=2),              # right
+    5: _arnn(move=1, body=1),        # jump + forward
+    6: _arnn(move=1, body=2),        # sneak + forward
+    7: _arnn(move=1, body=3),        # sprint + forward
+    8: _arnn(pitch=_CAM_DOWN),       # look down
+    9: _arnn(pitch=_CAM_UP),         # look up
+    10: _arnn(yaw=_CAM_DOWN),        # turn left
+    11: _arnn(yaw=_CAM_UP),          # turn right
+    12: _arnn(fn=_FN_USE),
+    13: _arnn(fn=_FN_DROP),
+    14: _arnn(fn=_FN_ATTACK),
+    15: _arnn(fn=_FN_CRAFT),
+    16: _arnn(fn=_FN_EQUIP),
+    17: _arnn(fn=_FN_PLACE),
+    18: _arnn(fn=_FN_DESTROY),
 }
 ITEM_ID_TO_NAME = dict(enumerate(ALL_ITEMS))
-ITEM_NAME_TO_ID = dict(zip(ALL_ITEMS, range(N_ALL_ITEMS)))
+ITEM_NAME_TO_ID = {name: i for i, name in enumerate(ALL_ITEMS)}
 ALL_TASKS_SPECS = copy.deepcopy(minedojo.tasks.ALL_TASKS_SPECS)
 
 
 def _norm(name: str) -> str:
     return "_".join(name.split(" "))
+
+
+def _item_vec(dtype=np.float64) -> np.ndarray:
+    return np.zeros(N_ALL_ITEMS, dtype=dtype)
 
 
 class MineDojoWrapper(gym.Env):
@@ -76,57 +102,58 @@ class MineDojoWrapper(gym.Env):
         sticky_jump: Optional[int] = 10,
         **kwargs: Optional[Dict[Any, Any]],
     ):
-        self._height = height
-        self._width = width
+        self._height, self._width = height, width
         self._pitch_limits = pitch_limits
+        self._break_speed = kwargs.pop("break_speed_multiplier", 100)
         self._pos = kwargs.get("start_position", None)
-        self._break_speed_multiplier = kwargs.pop("break_speed_multiplier", 100)
         self._start_pos = copy.deepcopy(self._pos)
-        # a break-speed boost makes sticky attack redundant (reference :76)
-        self._sticky_attack = 0 if self._break_speed_multiplier > 1 else sticky_attack
-        self._sticky_jump = sticky_jump
-        self._sticky_attack_counter = 0
-        self._sticky_jump_counter = 0
+        if self._pos is not None:
+            lo, hi = pitch_limits
+            if not lo <= self._pos["pitch"] <= hi:
+                raise ValueError(
+                    f"start_position pitch {self._pos['pitch']} outside pitch_limits [{lo}, {hi}]"
+                )
 
-        if self._pos is not None and not (
-            self._pitch_limits[0] <= self._pos["pitch"] <= self._pitch_limits[1]
-        ):
-            raise ValueError(
-                f"The initial position must respect the pitch limits {self._pitch_limits}, "
-                f"given {self._pos['pitch']}"
-            )
+        # when blocks break in one hit, holding the attack button adds
+        # nothing — sticky attack only matters at natural break speed
+        self._sticky_attack = sticky_attack if self._break_speed <= 1 else 0
+        self._sticky_jump = sticky_jump
+        self._attack_ttl = 0
+        self._jump_ttl = 0
 
         self.env = minedojo.make(
             task_id=id,
             image_size=(height, width),
             world_seed=seed,
             fast_reset=True,
-            break_speed_multiplier=self._break_speed_multiplier,
+            break_speed_multiplier=self._break_speed,
             **kwargs,
         )
-        self._inventory: Dict[str, Any] = {}
-        self._inventory_names = None
-        self._inventory_max = np.zeros(N_ALL_ITEMS)
+        self._slots_by_item: Dict[str, List[int]] = {}
+        self._slot_names: Optional[np.ndarray] = None
+        self._inventory_max = _item_vec()
+
         self.action_space = gym.spaces.MultiDiscrete(
-            np.array([len(ACTION_MAP.keys()), len(ALL_CRAFT_SMELT_ITEMS), N_ALL_ITEMS])
+            np.array([len(ACTION_MAP), len(ALL_CRAFT_SMELT_ITEMS), N_ALL_ITEMS])
         )
+        per_item = lambda lo, hi, dt: gym.spaces.Box(lo, hi, (N_ALL_ITEMS,), dt)  # noqa: E731
         self.observation_space = gym.spaces.Dict(
             {
                 "rgb": gym.spaces.Box(0, 255, self.env.observation_space["rgb"].shape, np.uint8),
-                "inventory": gym.spaces.Box(0.0, np.inf, (N_ALL_ITEMS,), np.float32),
-                "inventory_max": gym.spaces.Box(0.0, np.inf, (N_ALL_ITEMS,), np.float32),
-                "inventory_delta": gym.spaces.Box(-np.inf, np.inf, (N_ALL_ITEMS,), np.float32),
-                "equipment": gym.spaces.Box(0.0, 1.0, (N_ALL_ITEMS,), np.int32),
+                "inventory": per_item(0.0, np.inf, np.float32),
+                "inventory_max": per_item(0.0, np.inf, np.float32),
+                "inventory_delta": per_item(-np.inf, np.inf, np.float32),
+                "equipment": per_item(0.0, 1.0, np.int32),
                 "life_stats": gym.spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
                 "mask_action_type": gym.spaces.Box(0, 1, (len(ACTION_MAP),), bool),
-                "mask_equip_place": gym.spaces.Box(0, 1, (N_ALL_ITEMS,), bool),
-                "mask_destroy": gym.spaces.Box(0, 1, (N_ALL_ITEMS,), bool),
+                "mask_equip_place": per_item(0, 1, bool),
+                "mask_destroy": per_item(0, 1, bool),
                 "mask_craft_smelt": gym.spaces.Box(0, 1, (len(ALL_CRAFT_SMELT_ITEMS),), bool),
             }
         )
         self._render_mode = "rgb_array"
         self.seed(seed=seed)
-        # minedojo mutates the global task registry on make; restore it
+        # minedojo.make mutates the global task registry; put it back
         minedojo.tasks.ALL_TASKS_SPECS = copy.deepcopy(ALL_TASKS_SPECS)
 
     @property
@@ -138,105 +165,118 @@ class MineDojoWrapper(gym.Env):
             raise AttributeError(name)
         return getattr(self.env, name)
 
-    def _convert_inventory(self, inventory: Dict[str, Any]) -> np.ndarray:
-        counts = np.zeros(N_ALL_ITEMS)
-        self._inventory = {}
-        self._inventory_names = np.array([_norm(item) for item in inventory["name"].copy().tolist()])
-        for i, (item, quantity) in enumerate(zip(inventory["name"], inventory["quantity"])):
-            item = _norm(item)
-            self._inventory.setdefault(item, []).append(i)
-            # air occupies a slot but has no quantity
-            counts[ITEM_NAME_TO_ID[item]] += 1 if item == "air" else quantity
+    # -- observation conversion -------------------------------------------
+    def _scan_inventory(self, inventory: Dict[str, Any]) -> np.ndarray:
+        """Counts per item id; also rebuilds the item→slot map used to
+        dispatch equip/place/destroy to a concrete inventory slot."""
+        names = [_norm(n) for n in inventory["name"].tolist()]
+        self._slot_names = np.asarray(names)
+        self._slots_by_item = {}
+        counts = _item_vec()
+        for slot, (item, qty) in enumerate(zip(names, inventory["quantity"])):
+            self._slots_by_item.setdefault(item, []).append(slot)
+            # "air" fills a slot but reports no quantity — count the slot
+            counts[ITEM_NAME_TO_ID[item]] += 1 if item == "air" else qty
         self._inventory_max = np.maximum(counts, self._inventory_max)
         return counts
 
-    def _convert_inventory_delta(self, delta: Dict[str, Any]) -> np.ndarray:
-        out = np.zeros(N_ALL_ITEMS)
-        for names, quantities, sign in (
-            (delta["inc_name_by_craft"], delta["inc_quantity_by_craft"], 1),
-            (delta["dec_name_by_craft"], delta["dec_quantity_by_craft"], -1),
-            (delta["inc_name_by_other"], delta["inc_quantity_by_other"], 1),
-            (delta["dec_name_by_other"], delta["dec_quantity_by_other"], -1),
-        ):
-            for item, quantity in zip(names, quantities):
-                out[ITEM_NAME_TO_ID[_norm(item)]] += sign * quantity
+    def _scan_delta(self, delta: Dict[str, Any]) -> np.ndarray:
+        out = _item_vec()
+        for prefix in ("craft", "other"):
+            for sign, way in ((+1, "inc"), (-1, "dec")):
+                names = delta[f"{way}_name_by_{prefix}"]
+                quantities = delta[f"{way}_quantity_by_{prefix}"]
+                for item, qty in zip(names, quantities):
+                    out[ITEM_NAME_TO_ID[_norm(item)]] += sign * qty
         return out
 
-    def _convert_equipment(self, equipment: Dict[str, Any]) -> np.ndarray:
-        equip = np.zeros(N_ALL_ITEMS, dtype=np.int32)
-        equip[ITEM_NAME_TO_ID[_norm(equipment["name"][0])]] = 1
-        return equip
+    def _scan_equipment(self, equipment: Dict[str, Any]) -> np.ndarray:
+        onehot = _item_vec(np.int32)
+        onehot[ITEM_NAME_TO_ID[_norm(equipment["name"][0])]] = 1
+        return onehot
 
-    def _convert_masks(self, masks: Dict[str, Any]) -> Dict[str, np.ndarray]:
-        equip_mask = np.zeros(N_ALL_ITEMS, dtype=bool)
-        destroy_mask = np.zeros(N_ALL_ITEMS, dtype=bool)
-        for item, eqp, dst in zip(self._inventory_names, masks["equip"], masks["destroy"]):
+    def _scan_masks(self, masks: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        equip_mask = _item_vec(bool)
+        destroy_mask = _item_vec(bool)
+        for item, can_equip, can_destroy in zip(self._slot_names, masks["equip"], masks["destroy"]):
             idx = ITEM_NAME_TO_ID[item]
-            equip_mask[idx] = eqp
-            destroy_mask[idx] = dst
-        # equip/place (action types 16-17) need an equippable item; destroy
-        # (18) needs a destroyable one (reference :176-178)
-        masks["action_type"][5:7] *= np.any(equip_mask).item()
-        masks["action_type"][7] *= np.any(destroy_mask).item()
+            equip_mask[idx] |= bool(can_equip)
+            destroy_mask[idx] |= bool(can_destroy)
+        # head gating: equip/place need something equippable in the
+        # inventory, destroy something destroyable; movement/camera (first
+        # 12 macro-actions) are always legal
+        fn_mask = np.asarray(masks["action_type"], dtype=bool).copy()
+        fn_mask[5:7] &= equip_mask.any()
+        fn_mask[7] &= destroy_mask.any()
         return {
-            "mask_action_type": np.concatenate((np.array([True] * 12), masks["action_type"][1:])),
+            "mask_action_type": np.concatenate((np.ones(12, dtype=bool), fn_mask[1:])),
             "mask_equip_place": equip_mask,
             "mask_destroy": destroy_mask,
-            "mask_craft_smelt": masks["craft_smelt"],
+            "mask_craft_smelt": np.asarray(masks["craft_smelt"], dtype=bool),
         }
-
-    def _convert_action(self, action: np.ndarray) -> np.ndarray:
-        converted = ACTION_MAP[int(action[0])].copy()
-        if self._sticky_attack:
-            if converted[5] == 3:  # functional slot, attack value
-                self._sticky_attack_counter = self._sticky_attack - 1
-            if self._sticky_attack_counter > 0 and converted[5] == 0:
-                converted[5] = 3
-                self._sticky_attack_counter -= 1
-            elif converted[5] != 3:
-                self._sticky_attack_counter = 0
-        if self._sticky_jump:
-            if converted[2] == 1:  # jump value in jump/sneak/sprint slot
-                self._sticky_jump_counter = self._sticky_jump - 1
-            # parity: the reference guards on the MOVEMENT slot (minedojo.py
-            # :206), so any move/jump choice cancels the sticky jump
-            if self._sticky_jump_counter > 0 and converted[0] == 0:
-                converted[2] = 1
-                # a sticky jump also walks forward unless moving already
-                if converted[0] == converted[1] == 0:
-                    converted[0] = 1
-                self._sticky_jump_counter -= 1
-            elif converted[2] != 1:
-                self._sticky_jump_counter = 0
-        # craft takes the crafted-item head; equip/place/destroy take the
-        # inventory slot of the selected item (reference :218-227)
-        converted[6] = int(action[1]) if converted[5] == 4 else 0
-        if converted[5] in {5, 6, 7}:
-            converted[7] = self._inventory[ITEM_ID_TO_NAME[int(action[2])]][0]
-        else:
-            converted[7] = 0
-        return converted
 
     def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        life = obs["life_stats"]
         return {
             "rgb": obs["rgb"].copy(),
-            "inventory": self._convert_inventory(obs["inventory"]),
+            "inventory": self._scan_inventory(obs["inventory"]),
             "inventory_max": self._inventory_max,
-            "inventory_delta": self._convert_inventory_delta(obs["delta_inv"]),
-            "equipment": self._convert_equipment(obs["equipment"]),
-            "life_stats": np.concatenate(
-                (obs["life_stats"]["life"], obs["life_stats"]["food"], obs["life_stats"]["oxygen"])
-            ),
-            **self._convert_masks(obs["masks"]),
+            "inventory_delta": self._scan_delta(obs["delta_inv"]),
+            "equipment": self._scan_equipment(obs["equipment"]),
+            "life_stats": np.concatenate((life["life"], life["food"], life["oxygen"])),
+            **self._scan_masks(obs["masks"]),
         }
 
-    def _update_pos(self, obs: Dict[str, Any]) -> None:
-        self._pos = {
-            "x": float(obs["location_stats"]["pos"][0]),
-            "y": float(obs["location_stats"]["pos"][1]),
-            "z": float(obs["location_stats"]["pos"][2]),
-            "pitch": float(obs["location_stats"]["pitch"].item()),
-            "yaw": float(obs["location_stats"]["yaw"].item()),
+    # -- action conversion -------------------------------------------------
+    def _apply_sticky(self, arnn: np.ndarray) -> None:
+        """Sticky attack/jump: an attack (jump) choice arms a countdown that
+        keeps re-issuing it on no-op steps; any conflicting choice disarms."""
+        if self._sticky_attack:
+            if arnn[_FN] == _FN_ATTACK:
+                self._attack_ttl = self._sticky_attack - 1
+            elif arnn[_FN] == _FN_NOOP and self._attack_ttl > 0:
+                arnn[_FN] = _FN_ATTACK
+                self._attack_ttl -= 1
+            else:
+                self._attack_ttl = 0
+        if self._sticky_jump:
+            if arnn[_BODY] == _BODY_JUMP:
+                self._jump_ttl = self._sticky_jump - 1
+            elif arnn[_MOVE] == 0 and self._jump_ttl > 0:
+                arnn[_BODY] = _BODY_JUMP
+                if arnn[_STRAFE] == 0:
+                    # an un-directed sticky jump keeps the forward momentum
+                    arnn[_MOVE] = 1
+                self._jump_ttl -= 1
+            elif arnn[_BODY] != _BODY_JUMP:
+                self._jump_ttl = 0
+
+    def _convert_action(self, action: np.ndarray) -> np.ndarray:
+        arnn = ACTION_MAP[int(action[0])].copy()
+        self._apply_sticky(arnn)
+        arnn[_CRAFT_ARG] = int(action[1]) if arnn[_FN] == _FN_CRAFT else 0
+        if arnn[_FN] in _FN_NEEDS_SLOT:
+            arnn[_SLOT_ARG] = self._slots_by_item[ITEM_ID_TO_NAME[int(action[2])]][0]
+        else:
+            arnn[_SLOT_ARG] = 0
+        return arnn
+
+    # -- gym surface --------------------------------------------------------
+    def _position_of(self, obs: Dict[str, Any]) -> Dict[str, float]:
+        loc = obs["location_stats"]
+        x, y, z = (float(v) for v in loc["pos"])
+        return {"x": x, "y": y, "z": z, "pitch": float(loc["pitch"].item()), "yaw": float(loc["yaw"].item())}
+
+    def _stats_info(self, obs: Dict[str, Any]) -> Dict[str, Any]:
+        life = obs["life_stats"]
+        return {
+            "life_stats": {
+                "life": float(life["life"].item()),
+                "oxygen": float(life["oxygen"].item()),
+                "food": float(life["food"].item()),
+            },
+            "location_stats": copy.deepcopy(self._pos),
+            "biomeid": float(obs["location_stats"]["biome_id"].item()),
         }
 
     def seed(self, seed: Optional[int] = None) -> None:
@@ -245,42 +285,31 @@ class MineDojoWrapper(gym.Env):
 
     def step(self, action: np.ndarray):
         raw = action
-        action = self._convert_action(action)
-        next_pitch = self._pos["pitch"] + (action[3] - 12) * 15
-        if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
-            action[3] = 12
-        obs, reward, done, info = self.env.step(action)
-        is_timelimit = info.get("TimeLimit.truncated", False)
-        self._update_pos(obs)
-        info.update(
-            {
-                "life_stats": {
-                    "life": float(obs["life_stats"]["life"].item()),
-                    "oxygen": float(obs["life_stats"]["oxygen"].item()),
-                    "food": float(obs["life_stats"]["food"].item()),
-                },
-                "location_stats": copy.deepcopy(self._pos),
-                "action": raw.tolist(),
-                "biomeid": float(obs["location_stats"]["biome_id"].item()),
-            }
+        arnn = self._convert_action(action)
+        # hold the camera when the pitch bin would leave the allowed range
+        pitch_after = self._pos["pitch"] + (arnn[_PITCH] - _CAM_NOOP) * 15
+        if not self._pitch_limits[0] <= pitch_after <= self._pitch_limits[1]:
+            arnn[_PITCH] = _CAM_NOOP
+        obs, reward, done, info = self.env.step(arnn)
+        timelimit = bool(info.get("TimeLimit.truncated", False))
+        self._pos = self._position_of(obs)
+        info.update(self._stats_info(obs))
+        info["action"] = raw.tolist()
+        return (
+            self._convert_obs(obs),
+            reward,
+            done and not timelimit,
+            done and timelimit,
+            info,
         )
-        return self._convert_obs(obs), reward, done and not is_timelimit, done and is_timelimit, info
 
     def reset(self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
         obs = self.env.reset()
-        self._update_pos(obs)
-        self._sticky_jump_counter = 0
-        self._sticky_attack_counter = 0
-        self._inventory_max = np.zeros(N_ALL_ITEMS)
-        return self._convert_obs(obs), {
-            "life_stats": {
-                "life": float(obs["life_stats"]["life"].item()),
-                "oxygen": float(obs["life_stats"]["oxygen"].item()),
-                "food": float(obs["life_stats"]["food"].item()),
-            },
-            "location_stats": copy.deepcopy(self._pos),
-            "biomeid": float(obs["location_stats"]["biome_id"].item()),
-        }
+        self._pos = self._position_of(obs)
+        self._attack_ttl = 0
+        self._jump_ttl = 0
+        self._inventory_max = _item_vec()
+        return self._convert_obs(obs), self._stats_info(obs)
 
     def render(self):
         if self.render_mode == "human":

@@ -57,3 +57,20 @@ def test_dmc_pixels_obs():
     assert obs["rgb"].shape == (32, 32, 3)  # channel-last (TPU layout)
     assert obs["rgb"].dtype == np.uint8
     env.close()
+
+
+def test_actions_as_observation_key_is_action_stack():
+    """Parity regression (VERDICT round 2, missing #8): the stacked-action
+    obs key is `action_stack` (reference wrappers.py:258-342) so configs
+    ported from the reference (`mlp_keys: [action_stack]`) resolve."""
+    from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+    from sheeprl_tpu.envs.wrappers import ActionsAsObservationWrapper
+
+    env = ActionsAsObservationWrapper(DiscreteDummyEnv(), num_stack=3, noop=0)
+    obs, _ = env.reset()
+    assert "action_stack" in env.observation_space.spaces
+    assert "action_stack" in obs
+    assert obs["action_stack"].shape == (3 * env.action_space.n,)
+    obs, *_ = env.step(1)
+    one_hot = obs["action_stack"].reshape(3, env.action_space.n)
+    assert one_hot[-1, 1] == 1.0  # newest action last
