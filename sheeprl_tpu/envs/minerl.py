@@ -8,12 +8,13 @@ value), sticky attack/jump counters, pitch limits, and the observation dict
 {rgb, life_stats, inventory, max_inventory[, compass][, equipment]} with
 optional multihot item encoding.
 
-Divergence (documented): the reference registers customized Navigate/Obtain
-task specs with adjustable `break_speed` (minerl_envs/, reference
-minerl.py:43-46); here tasks are resolved through `minerl`'s standard
-registry via `gym.make(id)`. The `break_speed_multiplier` still controls the
-sticky-attack heuristic. MineRL 0.4.4 predates gymnasium and modern Python;
-this adapter is untested against live Malmo instances.
+Task resolution: the customized Navigate/Obtain specs with adjustable
+`break_speed` live in `minerl_envs/` (reference minerl.py:19-23 +
+minerl_envs/) and are selected by id (`custom_navigate`,
+`custom_obtain_diamond`, `custom_obtain_iron_pickaxe`); any other id goes
+through `minerl`'s standard registry via `gym.make(id)`. MineRL 0.4.4
+predates gymnasium and modern Python; this adapter is untested against live
+Malmo instances.
 """
 from __future__ import annotations
 
@@ -62,7 +63,17 @@ class MineRLWrapper(gym.Env):
         self._sticky_jump_counter = 0
         self._break_speed_multiplier = break_speed_multiplier
         self._multihot_inventory = multihot_inventory
-        self.env = legacy_gym.make(id)
+        from .minerl_envs import CUSTOM_TASKS
+
+        if id.lower() in CUSTOM_TASKS:
+            if "navigate" not in id.lower():
+                kwargs.pop("extreme", None)
+            spec = CUSTOM_TASKS[id.lower()](
+                break_speed=break_speed_multiplier, resolution=(height, width), **kwargs
+            )
+            self.env = spec.make()
+        else:
+            self.env = legacy_gym.make(id)
 
         # flat Discrete action space over the MineRL dict space
         # (reference minerl.py:100-141)

@@ -1,0 +1,24 @@
+"""Customized MineRL task specs (reference sheeprl/envs/minerl_envs/):
+Navigate[Extreme][Dense] and Obtain{Diamond,IronPickaxe}[Dense] with
+adjustable break speed. Import requires minerl 0.4.4."""
+from .backend import BreakSpeedMultiplier, SimpleEmbodimentBase
+from .navigate import NAVIGATE_STEPS, CustomNavigate
+from .obtain import CustomObtain, CustomObtainDiamond, CustomObtainIronPickaxe
+
+#: `env.id` (lowercased) → spec class, consumed by envs/minerl.py
+CUSTOM_TASKS = {
+    "custom_navigate": CustomNavigate,
+    "custom_obtain_diamond": CustomObtainDiamond,
+    "custom_obtain_iron_pickaxe": CustomObtainIronPickaxe,
+}
+
+__all__ = [
+    "BreakSpeedMultiplier",
+    "SimpleEmbodimentBase",
+    "CustomNavigate",
+    "CustomObtain",
+    "CustomObtainDiamond",
+    "CustomObtainIronPickaxe",
+    "CUSTOM_TASKS",
+    "NAVIGATE_STEPS",
+]

@@ -1,0 +1,70 @@
+"""Base spec for the customized MineRL tasks.
+
+Behavioral spec from reference sheeprl/envs/minerl_envs/backend.py (itself
+adapted from minerllabs/minerl): a minimal embodiment — POV camera +
+location/life observations, the 8 simple keyboard actions + camera — with a
+configurable block-break speed injected into the Malmo mission XML (the knob
+the reference's Minecraft results depend on; stock MineRL specs don't
+expose it)."""
+from __future__ import annotations
+
+from ...utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError(str(_IS_MINERL_AVAILABLE))
+
+from abc import ABC
+from typing import List
+
+from minerl.herobraine.env_spec import EnvSpec
+from minerl.herobraine.hero import handler, handlers
+from minerl.herobraine.hero.handlers.translation import TranslationHandler
+from minerl.herobraine.hero.mc import INVERSE_KEYMAP
+
+#: the movement/interaction keys the simple embodiment exposes
+KEYBOARD_ACTIONS = ("forward", "back", "left", "right", "jump", "sneak", "sprint", "attack")
+
+
+class BreakSpeedMultiplier(handler.Handler):
+    """Malmo mission-XML knob scaling block break speed (the diamond_env
+    trick): >1 makes held attacks unnecessary."""
+
+    def __init__(self, multiplier: float = 1.0):
+        self.multiplier = multiplier
+
+    def to_string(self) -> str:
+        return f"break_speed({self.multiplier})"
+
+    def xml_template(self) -> str:
+        return "<BreakSpeedMultiplier>{{multiplier}}</BreakSpeedMultiplier>"
+
+
+class SimpleEmbodimentBase(EnvSpec, ABC):
+    """POV + location + life stats; keyboard movement + camera; adjustable
+    break speed. Task specs extend the observable/actionable lists."""
+
+    def __init__(self, name, *args, resolution=(64, 64), break_speed: int = 100, **kwargs):
+        self.resolution = resolution
+        self.break_speed = break_speed
+        super().__init__(name, *args, **kwargs)
+
+    def create_agent_start(self) -> List[handler.Handler]:
+        return [BreakSpeedMultiplier(self.break_speed)]
+
+    def create_observables(self) -> List[TranslationHandler]:
+        return [
+            handlers.POVObservation(self.resolution),
+            handlers.ObservationFromCurrentLocation(),
+            handlers.ObservationFromLifeStats(),
+        ]
+
+    def create_actionables(self) -> List[TranslationHandler]:
+        keyboard = [
+            handlers.KeybasedCommandAction(key, mapping)
+            for key, mapping in INVERSE_KEYMAP.items()
+            if key in KEYBOARD_ACTIONS
+        ]
+        return keyboard + [handlers.CameraAction()]
+
+    def create_monitors(self) -> List[TranslationHandler]:
+        return []

@@ -1,0 +1,106 @@
+"""Customized MineRL Navigate task.
+
+Behavioral spec from reference sheeprl/envs/minerl_envs/navigate.py (adapted
+from minerllabs/minerl): reach a diamond block guided by a compass; +100 on
+touching it, optionally +1/block of compass progress (dense); extreme-hills
+biome variant. Episode length is deliberately unlimited — MineRL cannot
+distinguish terminated from truncated, so the TimeLimit lives in the
+gymnasium pipeline where the flags are separable."""
+from __future__ import annotations
+
+from ...utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError(str(_IS_MINERL_AVAILABLE))
+
+from typing import List
+
+import minerl.herobraine.hero.handlers as handlers
+from minerl.herobraine.hero.handler import Handler
+
+from .backend import SimpleEmbodimentBase
+
+NAVIGATE_STEPS = 6000
+_TARGET_BLOCK = "diamond_block"
+_EXTREME_BIOME = 3  # extreme hills
+
+
+class CustomNavigate(SimpleEmbodimentBase):
+    def __init__(self, dense: bool, extreme: bool, *args, **kwargs):
+        self.dense, self.extreme = dense, extreme
+        variant = ("Extreme" if extreme else "") + ("Dense" if dense else "")
+        # time limit handled by the gymnasium TimeLimit wrapper (see module
+        # docstring), so the spec itself never truncates
+        kwargs.pop("max_episode_steps", None)
+        super().__init__(f"CustomMineRLNavigate{variant}-v0", *args, max_episode_steps=None, **kwargs)
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == ("navigateextreme" if self.extreme else "navigate")
+
+    def create_observables(self) -> List[Handler]:
+        return super().create_observables() + [
+            handlers.CompassObservation(angle=True, distance=False),
+            handlers.FlatInventoryObservation(["dirt"]),
+        ]
+
+    def create_actionables(self) -> List[Handler]:
+        place_dirt = handlers.PlaceBlock(["none", "dirt"], _other="none", _default="none")
+        return super().create_actionables() + [place_dirt]
+
+    def create_rewardables(self) -> List[Handler]:
+        goal = handlers.RewardForTouchingBlockType(
+            [{"type": _TARGET_BLOCK, "behaviour": "onceOnly", "reward": 100.0}]
+        )
+        shaping = (
+            [handlers.RewardForDistanceTraveledToCompassTarget(reward_per_block=1.0)]
+            if self.dense
+            else []
+        )
+        return [goal] + shaping
+
+    def create_agent_start(self) -> List[Handler]:
+        compass = handlers.SimpleInventoryAgentStart([dict(type="compass", quantity="1")])
+        return super().create_agent_start() + [compass]
+
+    def create_agent_handlers(self) -> List[Handler]:
+        return [handlers.AgentQuitFromTouchingBlockType([_TARGET_BLOCK])]
+
+    def create_server_world_generators(self) -> List[Handler]:
+        if self.extreme:
+            return [handlers.BiomeGenerator(biome=_EXTREME_BIOME, force_reset=True)]
+        return [handlers.DefaultWorldGenerator(force_reset=True)]
+
+    def create_server_quit_producers(self) -> List[Handler]:
+        return [handlers.ServerQuitWhenAnyAgentFinishes()]
+
+    def create_server_decorators(self) -> List[Handler]:
+        return [
+            handlers.NavigationDecorator(
+                max_randomized_radius=64,
+                min_randomized_radius=64,
+                block=_TARGET_BLOCK,
+                placement="surface",
+                max_radius=8,
+                min_radius=0,
+                max_randomized_distance=8,
+                min_randomized_distance=0,
+                randomize_compass_location=True,
+            )
+        ]
+
+    def create_server_initial_conditions(self) -> List[Handler]:
+        return [
+            handlers.TimeInitialCondition(allow_passage_of_time=False, start_time=6000),
+            handlers.WeatherInitialCondition("clear"),
+            handlers.SpawningInitialCondition("false"),
+        ]
+
+    def get_docstring(self) -> str:
+        return (
+            "Reach the diamond block indicated by the compass (+100 once on "
+            "touch" + (", +1 per block of compass progress" if self.dense else "") + ")."
+        )
+
+    def determine_success_from_rewards(self, rewards: list) -> bool:
+        threshold = 100.0 + (60.0 if self.dense else 0.0)
+        return sum(rewards) >= threshold

@@ -74,3 +74,37 @@ def test_actions_as_observation_key_is_action_stack():
     obs, *_ = env.step(1)
     one_hot = obs["action_stack"].reshape(3, env.action_space.n)
     assert one_hot[-1, 1] == 1.0  # newest action last
+
+
+def test_minerl_custom_specs_gated():
+    """Custom Navigate/Obtain specs (VERDICT round 2, missing #7): available
+    behind the minerl gate, with a helpful error when the SDK is absent."""
+    from sheeprl_tpu.utils import imports as _imports
+
+    if _imports._IS_MINERL_AVAILABLE:
+        from sheeprl_tpu.envs.minerl_envs import CUSTOM_TASKS
+
+        assert set(CUSTOM_TASKS) == {
+            "custom_navigate",
+            "custom_obtain_diamond",
+            "custom_obtain_iron_pickaxe",
+        }
+        nav = CUSTOM_TASKS["custom_navigate"](dense=True, extreme=False, break_speed=100)
+        assert nav.name == "CustomMineRLNavigateDense-v0"
+    else:
+        with pytest.raises(ModuleNotFoundError, match="minerl"):
+            import sheeprl_tpu.envs.minerl_envs  # noqa: F401
+
+
+def test_minerl_env_configs_compose():
+    from sheeprl_tpu.config import compose
+
+    cfg = compose("config", ["exp=dreamer_v3", "env=minerl_obtain_diamond",
+                             "algo.cnn_keys.encoder=[rgb]"])
+    assert cfg.env.id == "custom_obtain_diamond"
+    assert cfg.env.wrapper.dense is False
+    assert cfg.env.wrapper.multihot_inventory is True
+    cfg = compose("config", ["exp=dreamer_v3", "env=minerl",
+                             "algo.cnn_keys.encoder=[rgb]"])
+    assert cfg.env.id == "custom_navigate"
+    assert cfg.env.wrapper.dense is True and cfg.env.wrapper.extreme is False
