@@ -270,8 +270,13 @@ class TruncatedStandardNormal(Distribution):
     def cdf(self, value):
         return jnp.clip((self._big_phi(value) - self._big_phi_a) / self._Z, 0, 1)
 
-    def icdf(self, value):
+    def _std_icdf(self, value):
+        # named (not `self.icdf`) so `sample` stays in std space even when a
+        # loc/scale subclass overrides the public icdf to value space
         return self._inv_big_phi(self._big_phi_a + value * self._Z)
+
+    def icdf(self, value):
+        return self._std_icdf(value)
 
     def log_prob(self, value):
         return CONST_LOG_INV_SQRT_2PI - self._log_Z - 0.5 * jnp.square(value)
@@ -280,7 +285,7 @@ class TruncatedStandardNormal(Distribution):
         shape = tuple(sample_shape) + jnp.broadcast_shapes(self.a.shape, self.b.shape)
         eps = jnp.finfo(jnp.float32).eps
         u = jax.random.uniform(key, shape, minval=eps, maxval=1 - eps)
-        return jnp.clip(self.icdf(u), self.a, self.b)
+        return jnp.clip(self._std_icdf(u), self.a, self.b)
 
 
 class TruncatedNormal(TruncatedStandardNormal):
@@ -307,6 +312,10 @@ class TruncatedNormal(TruncatedStandardNormal):
     @property
     def mode(self):
         return jnp.clip(self.loc, self._raw_a, self._raw_b)
+
+    @property
+    def variance(self):
+        return super().variance * jnp.square(self.scale)
 
     def entropy(self):
         return super().entropy() + jnp.log(self.scale) * jnp.ones_like(self.loc)
