@@ -125,6 +125,19 @@ def run_algorithm(cfg: Config) -> None:
         MetricAggregator.disabled = True
     if cfg.select("metric.disable_timer", False):
         timer.disabled = True
+    if cfg.select("metric.profiler.enabled", False):
+        # XLA-level trace of the whole run (device programs, transfers and
+        # host gaps), viewable in TensorBoard's profiler tab — the tool for
+        # diagnosing host-bound env loops vs device-bound train steps
+        import jax
+
+        trace_dir = str(
+            cfg.select("metric.profiler.trace_dir")
+            or f"logs/profiler/{cfg.root_dir}/{cfg.run_name}"  # unique per run
+        )
+        with jax.profiler.trace(trace_dir):
+            fn(dist, cfg, **kwargs)
+        return
     fn(dist, cfg, **kwargs)
 
 

@@ -81,3 +81,11 @@ def test_registration_populates_registry(trained_ckpt):
     assert entries, "registration wrote no model registry entry"
     metas = glob.glob("models_registry/ppo_discrete_dummy*/v1/meta.json")
     assert metas
+
+
+def test_profiler_trace_writes_artifacts():
+    run(PPO_ARGS + ["metric.profiler.enabled=True", "metric.profiler.trace_dir=prof_out",
+                    "algo.total_steps=32", "checkpoint.every=0"])
+    import glob as _glob
+
+    assert _glob.glob("prof_out/**/*.xplane.pb", recursive=True), "no profiler trace written"

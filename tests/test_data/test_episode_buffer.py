@@ -67,3 +67,62 @@ def test_oversized_episode_raises():
     eb = EpisodeBuffer(buffer_size=5, n_envs=1)
     with pytest.raises(RuntimeError):
         eb.add(_steps(8, 1, done_at=7))
+
+
+def test_multi_env_independent_open_episodes():
+    eb = EpisodeBuffer(buffer_size=64, n_envs=2)
+    data = _steps(6, 2)
+    data["terminated"][3, 0] = 1.0  # env 0 closes at t=3, env 1 stays open
+    eb.add(data)
+    assert len(eb.buffer) == 1  # only env 0's episode committed
+    assert eb._open[0] is not None and len(eb._open[0]["terminated"]) == 2  # post-done rows reopen
+    assert eb._open[1] is not None and len(eb._open[1]["terminated"]) == 6
+
+
+def test_prioritize_ends_biases_final_windows():
+    eb = EpisodeBuffer(buffer_size=512, n_envs=1, prioritize_ends=True)
+    eb.add(_steps(100, 1, done_at=99))
+    np.random.seed(0)
+    out = eb.sample(256, sequence_length=10, n_samples=1)
+    # with prioritize_ends the last window (ending at t=99) must be sampled
+    # far more often than the 1/91 a uniform sampler would give it
+    last_step_hits = (out["observations"][0, -1, :, 0] == 99).mean()
+    assert last_step_hits > 0.05, f"ends not prioritized: {last_step_hits}"
+
+    eb_uniform = EpisodeBuffer(buffer_size=512, n_envs=1, prioritize_ends=False)
+    eb_uniform.add(_steps(100, 1, done_at=99))
+    out_u = eb_uniform.sample(256, sequence_length=10, n_samples=1)
+    uniform_hits = (out_u["observations"][0, -1, :, 0] == 99).mean()
+    assert last_step_hits > uniform_hits
+
+
+def test_state_dict_roundtrip_preserves_samples():
+    eb = EpisodeBuffer(buffer_size=64, n_envs=1)
+    eb.add(_steps(20, 1, done_at=19))
+    clone = EpisodeBuffer(buffer_size=64, n_envs=1)
+    clone.load_state_dict(eb.state_dict())
+    assert len(clone) == len(eb)
+    np.random.seed(1)
+    a = clone.sample(4, sequence_length=5)
+    assert a["observations"].shape == (1, 5, 4, 1)
+
+
+def test_truncated_also_closes_episode():
+    eb = EpisodeBuffer(buffer_size=64, n_envs=1)
+    data = _steps(8, 1)
+    data["truncated"][5] = 1.0
+    eb.add(data)
+    assert len(eb.buffer) == 1
+    assert len(next(iter(eb.buffer[0].values()))) == 6
+
+
+def test_eviction_frees_oldest_first():
+    eb = EpisodeBuffer(buffer_size=12, n_envs=1)
+    for mark in range(4):
+        d = _steps(4, 1, done_at=3)
+        d["observations"] = np.full((4, 1, 1), mark, np.float32)
+        eb.add(d)
+    kept_marks = {int(np.ravel(ep["observations"])[0]) for ep in eb.buffer}
+    assert 0 not in kept_marks  # the oldest episode was evicted
+    assert 3 in kept_marks      # the newest survives
+    assert len(eb) <= 12
