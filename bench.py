@@ -17,9 +17,15 @@ Default (`python bench.py`): two DreamerV3 measurements —
    the obs/action shapes and therefore the XLA programs are identical).
 
 Robustness contract (the round-2 run broke it — BENCH_r02 rc=124):
+* a PREFLIGHT subprocess (`BENCH_PREFLIGHT_BUDGET_S`, 180 s) first proves
+  the device link is alive (client creation + one op); if it can't, the
+  bench prints an error headline immediately instead of hanging;
 * each measurement runs in a SUBPROCESS with its own wall-clock budget
-  (`BENCH_E2E_BUDGET_S`, default 1500 s; `BENCH_STEP_BUDGET_S`, default
-  900 s), so a wedged device link cannot hang the whole bench;
+  (`BENCH_E2E_BUDGET_S`, default 1100 s; `BENCH_STEP_BUDGET_S`, default
+  420 s), so a wedged device link cannot hang the whole bench;
+* the end-to-end run additionally caps itself (`algo.max_wall_time_s` =
+  `BENCH_E2E_WALL_S`, 950 s): on a slower-than-expected machine it stops at
+  a step boundary and reports SPS over the steps that actually ran;
 * inside a measurement all training output is redirected to stderr — the
   only thing a subprocess writes to stdout is its one JSON line;
 * if the end-to-end leg fails or times out, the compute-only record is
@@ -83,10 +89,19 @@ def bench_ppo() -> dict:
 def bench_dreamer_e2e(which: str) -> dict:
     """The reference's 16_384-step Dreamer micro-bench, end to end through
     the CLI (env stepping + replay + prefetch + train), dummy Atari shapes.
-    Training/config output goes to stderr; the caller prints the JSON."""
+    Training/config output goes to stderr; the caller prints the JSON.
+
+    The run carries its own wall-clock cap (`algo.max_wall_time_s`,
+    BENCH_E2E_WALL_S, default 950 s): if the machine is slower than expected
+    it stops cleanly at a step boundary and the SPS is computed over the
+    steps that actually ran, instead of the subprocess being killed with
+    nothing on stdout."""
     from sheeprl_tpu.cli import run
+    from sheeprl_tpu.utils import run_info
 
     steps = DREAMER_TOTAL_STEPS
+    wall_cap = float(os.environ.get("BENCH_E2E_WALL_S", 950))
+    run_info.last_run.clear()  # don't inherit a previous leg's policy_step
     t0 = time.perf_counter()
     with contextlib.redirect_stdout(sys.stderr):
         run(
@@ -97,6 +112,7 @@ def bench_dreamer_e2e(which: str) -> dict:
                 "algo.cnn_keys.encoder=[rgb]",
                 "algo.mlp_keys.encoder=[]",
                 f"algo.total_steps={steps}",
+                f"algo.max_wall_time_s={wall_cap}",
                 f"buffer.size={steps}",
                 "buffer.checkpoint=False",
                 "buffer.memmap=False",
@@ -107,9 +123,11 @@ def bench_dreamer_e2e(which: str) -> dict:
             ]
         )
     elapsed = time.perf_counter() - t0
-    sps = steps / elapsed
+    recorded = run_info.last_run.get("policy_step")  # set only on wall-cap stop
+    steps_done = steps if recorded is None else int(recorded)
+    sps = steps_done / elapsed
     baseline_sps = DREAMER_TOTAL_STEPS_REF / DREAMER_BASELINE_SECONDS[which]
-    return {
+    rec = {
         "metric": f"Dreamer{which.upper().replace('DV', 'V')} {steps}-step micro-bench policy "
         "SPS (reference recipe end-to-end: env+replay+train, dummy Atari shapes, ckpt off)",
         "value": round(sps, 2),
@@ -117,8 +135,11 @@ def bench_dreamer_e2e(which: str) -> dict:
         "vs_baseline": round(sps / baseline_sps, 3),
         "elapsed_seconds": round(elapsed, 2),
         "baseline_seconds": DREAMER_BASELINE_SECONDS[which],
-        "steps": steps,
+        "steps": steps_done,
     }
+    if steps_done < steps:
+        rec["wall_capped"] = True
+    return rec
 
 
 DREAMER_TOTAL_STEPS_REF = 16_384  # the baseline recipe's step count
@@ -149,12 +170,34 @@ def _run_subprocess_record(argv: list, budget_s: float) -> dict | None:
         return None
 
 
+def bench_preflight() -> dict:
+    """Create the device client and run one op — proves the accelerator link
+    is alive before the expensive legs burn their budgets on a dead tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    x = jnp.ones((256, 256))
+    float((x @ x).sum())
+    return {
+        "ok": True,
+        "device": str(dev),
+        "platform": dev.platform,
+        "seconds": round(time.perf_counter() - t0, 2),
+    }
+
+
 def main() -> None:
     arg = sys.argv[1] if len(sys.argv) > 1 else ""
     if arg == "ppo":
         print(json.dumps(bench_ppo()))
     elif arg in DREAMER_EXPS:
         print(json.dumps(bench_dreamer_e2e(arg)))
+    elif arg == "preflight":
+        with contextlib.redirect_stdout(sys.stderr):
+            rec = bench_preflight()
+        print(json.dumps(rec))
     elif arg == "dv3_step":
         import bench_dv3
 
@@ -162,8 +205,28 @@ def main() -> None:
             rec = bench_dv3.record()
         print(json.dumps(rec))
     else:
-        step_budget = float(os.environ.get("BENCH_STEP_BUDGET_S", 900))
-        e2e_budget = float(os.environ.get("BENCH_E2E_BUDGET_S", 1500))
+        preflight_budget = float(os.environ.get("BENCH_PREFLIGHT_BUDGET_S", 180))
+        pre = _run_subprocess_record(["preflight"], preflight_budget)
+        if pre is None or not pre.get("ok"):
+            # dead device link: fail fast with a parseable headline instead of
+            # burning both legs' budgets hanging in client creation
+            print(
+                json.dumps(
+                    {
+                        "metric": "DreamerV3 16384-step micro-bench policy SPS (end-to-end)",
+                        "value": 0.0,
+                        "unit": "env steps/sec",
+                        "vs_baseline": 0.0,
+                        "error": "preflight failed: device client creation or first op "
+                        f"did not complete within {preflight_budget}s (tunnel down?)",
+                    }
+                )
+            )
+            return
+        print(f"[bench] preflight ok: {pre}", file=sys.stderr)
+        os.environ.setdefault("SHEEPRL_TPU_PROGRESS", "1024")  # pacing → stderr
+        step_budget = float(os.environ.get("BENCH_STEP_BUDGET_S", 420))
+        e2e_budget = float(os.environ.get("BENCH_E2E_BUDGET_S", 1100))
         step_rec = _run_subprocess_record(["dv3_step"], step_budget)
         if step_rec is not None:
             print(json.dumps(step_rec), flush=True)

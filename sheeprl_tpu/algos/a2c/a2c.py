@@ -23,13 +23,14 @@ from ...data import ReplayBuffer
 from ...ops import gae as gae_op
 from ...optim import clipped
 from ...parallel import Distributed
+from ...parallel.placement import make_param_mirror
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...utils.timer import timer
-from ...utils.utils import save_configs
+from ...utils.utils import WallClockStopper, save_configs, wall_cap_reached
 from ..ppo.utils import prepare_obs, test
 from .agent import actions_and_log_probs, build_agent
 from .loss import policy_loss, value_loss
@@ -104,6 +105,11 @@ def main(dist: Distributed, cfg: Config) -> None:
     act = make_act_fn(module)
     value_fn = make_value_fn(module)
     update = make_update_fn(module, tx, cfg)
+    # per-step inference on the player device (host CPU when the mesh is a
+    # remote accelerator); blocking refresh keeps A2C on-policy
+    mirror, pdev, player_key, root_key = make_param_mirror(
+        cfg, dist.local_device, params, root_key, allow_async=False
+    )
     gae_fn = jax.jit(
         partial(gae_op, num_steps=rollout_steps, gamma=cfg.algo.gamma, gae_lambda=cfg.algo.gae_lambda)
     )
@@ -123,12 +129,24 @@ def main(dist: Distributed, cfg: Config) -> None:
 
     obs, _ = envs.reset(seed=cfg.seed)
 
+    def _ckpt_state():
+        return {
+            "params": params,
+            "opt_state": opt_state,
+            "update": update_iter,
+            "policy_step": policy_step,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng": root_key,
+        }
+
+    wall = WallClockStopper(cfg)
     for update_iter in range(start_iter, num_updates + 1):
         with timer("Time/env_interaction_time"):
             for _ in range(rollout_steps):
                 device_obs = prepare_obs(obs, (), mlp_keys, num_envs)
-                root_key, act_key = jax.random.split(root_key)
-                actions, logprobs, values = act(params, device_obs, act_key)
+                player_key, act_key = jax.random.split(player_key)
+                actions, logprobs, values = act(mirror.current(), device_obs, act_key)
                 np_actions = np.asarray(actions)
                 if module.is_continuous:
                     env_actions = np_actions.reshape(num_envs, -1)
@@ -150,7 +168,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                         k: np.stack([np.asarray(final_obs[i][k]) for i in trunc_idx]) for k in obs_keys
                     }
                     vals = np.asarray(
-                        value_fn(params, prepare_obs(stacked, (), mlp_keys, len(trunc_idx)))
+                        value_fn(mirror.current(), prepare_obs(stacked, (), mlp_keys, len(trunc_idx)))
                     )
                     rewards[trunc_idx] += cfg.algo.gamma * vals.reshape(-1, 1)
 
@@ -170,7 +188,7 @@ def main(dist: Distributed, cfg: Config) -> None:
 
         with timer("Time/train_time"):
             local = rb.buffer
-            next_value = value_fn(params, prepare_obs(obs, (), mlp_keys, num_envs))
+            next_value = value_fn(mirror.current(), prepare_obs(obs, (), mlp_keys, num_envs))
             returns, advantages = gae_fn(
                 jnp.asarray(local["rewards"]),
                 jnp.asarray(local["values"]),
@@ -182,6 +200,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             data["advantages"] = advantages.reshape(total_batch, 1)
             data = {k: jax.device_put(v, dist.batch_sharding) for k, v in data.items()}
             params, opt_state, metrics = update(params, opt_state, data)
+            mirror.refresh(params)  # blocking: next rollout acts with fresh params
 
         for k, v in metrics.items():
             aggregator.update(k, np.asarray(v))
@@ -201,18 +220,10 @@ def main(dist: Distributed, cfg: Config) -> None:
             cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
         ) or cfg.dry_run or update_iter == num_updates:
             last_checkpoint = policy_step
-            ckpt.save(
-                policy_step,
-                {
-                    "params": params,
-                    "opt_state": opt_state,
-                    "update": update_iter,
-                    "policy_step": policy_step,
-                    "last_log": last_log,
-                    "last_checkpoint": last_checkpoint,
-                    "rng": root_key,
-                },
-            )
+            ckpt.save(policy_step, _ckpt_state())
+
+        if wall_cap_reached(wall, policy_step, int(cfg.algo.total_steps), ckpt, _ckpt_state, cfg):
+            break
 
     envs.close()
     if rank == 0 and cfg.algo.run_test:

@@ -37,7 +37,7 @@ from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...utils.timer import timer
-from ...utils.utils import Ratio, save_configs
+from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
 from ..dreamer_v2.dreamer_v2 import make_player as make_dreamer_player
 from .agent import DV1WorldModel, build_agent, dv2_sample_actions
 from .loss import actor_loss, critic_loss, reconstruction_loss
@@ -387,7 +387,24 @@ def main(dist: Distributed, cfg: Config) -> None:
     step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
     rb.add(step_data)
 
+    def _ckpt_state():
+        s = {
+            "params": params,
+            "opt_states": opt_states,
+            "ratio": ratio.state_dict(),
+            "policy_step": policy_step,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng": root_key,
+        }
+        if cfg.buffer.checkpoint:
+            s["rb"] = rb.checkpoint_state_dict()
+        return s
+
+    wall = WallClockStopper(cfg)
     while policy_step < total_steps:
+        if wall_cap_reached(wall, policy_step, total_steps, ckpt, _ckpt_state, cfg):
+            break
         with timer("Time/env_interaction_time"):
             if policy_step <= learning_starts:
                 actions_env = np.stack([action_space.sample() for _ in range(num_envs)])
@@ -493,18 +510,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
         ) or cfg.dry_run or policy_step >= total_steps:
             last_checkpoint = policy_step
-            ckpt_state = {
-                "params": params,
-                "opt_states": opt_states,
-                "ratio": ratio.state_dict(),
-                "policy_step": policy_step,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-                "rng": root_key,
-            }
-            if cfg.buffer.checkpoint:
-                ckpt_state["rb"] = rb.checkpoint_state_dict()
-            ckpt.save(policy_step, ckpt_state)
+            ckpt.save(policy_step, _ckpt_state())
 
     envs.close()
     if rank == 0 and cfg.algo.run_test:

@@ -49,7 +49,7 @@ from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...utils.timer import timer
-from ...utils.utils import Ratio, save_configs
+from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
 from .agent import Actor, WorldModel, build_agent, sample_actor_actions
 from .loss import reconstruction_loss
 from .utils import (
@@ -494,11 +494,29 @@ def main(dist: Distributed, cfg: Config) -> None:
     step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["is_first"] = np.ones((1, num_envs, 1), np.float32)
 
+    def _ckpt_state() -> Dict[str, Any]:
+        s: Dict[str, Any] = {
+            "params": params,
+            "opt_states": opt_states,
+            "moments": moments,
+            "ratio": ratio.state_dict(),
+            "policy_step": policy_step,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng": root_key,
+        }
+        if cfg.buffer.checkpoint:
+            s["rb"] = rb.checkpoint_state_dict()
+        return s
+
     # SHEEPRL_TPU_PROGRESS=N: wall-clock trace every N policy steps (stderr)
     _progress = int(os.environ.get("SHEEPRL_TPU_PROGRESS", "0") or 0)
+    wall = WallClockStopper(cfg)
     _t0 = time.perf_counter()
 
     while policy_step < total_steps:
+        if wall_cap_reached(wall, policy_step, total_steps, ckpt, _ckpt_state, cfg):
+            break
         if _progress and policy_step % _progress < num_envs:
             print(
                 f"[progress] step={policy_step} t={time.perf_counter() - _t0:.1f}s",
@@ -630,19 +648,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
         ) or cfg.dry_run or policy_step >= total_steps:
             last_checkpoint = policy_step
-            ckpt_state = {
-                "params": params,
-                "opt_states": opt_states,
-                "moments": moments,
-                "ratio": ratio.state_dict(),
-                "policy_step": policy_step,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-                "rng": root_key,
-            }
-            if cfg.buffer.checkpoint:
-                ckpt_state["rb"] = rb.checkpoint_state_dict()
-            ckpt.save(policy_step, ckpt_state)
+            ckpt.save(policy_step, _ckpt_state())
 
     envs.close()
     if rank == 0 and cfg.algo.run_test:

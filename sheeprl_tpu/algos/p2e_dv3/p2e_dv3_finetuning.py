@@ -26,7 +26,7 @@ from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm
 from ...utils.timer import timer
-from ...utils.utils import Ratio, save_configs
+from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
 from ..dreamer_v3.agent import build_agent as dv3_build_agent
 from ..dreamer_v3.dreamer_v3 import make_player, make_train_fn
 from ..dreamer_v3.utils import AGGREGATOR_KEYS, init_moments, prepare_obs, test  # noqa: F401
@@ -174,7 +174,26 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
     step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["is_first"] = np.ones((1, num_envs, 1), np.float32)
 
+    def _ckpt_state():
+        s = {
+            "params": params,
+            "actor_exploration": actor_exploration_params,
+            "opt_states": opt_states,
+            "moments": moments,
+            "ratio": ratio.state_dict(),
+            "policy_step": policy_step,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng": root_key,
+        }
+        if cfg.buffer.checkpoint:
+            s["rb"] = rb.checkpoint_state_dict()
+        return s
+
+    wall = WallClockStopper(cfg)
     while policy_step < total_steps:
+        if wall_cap_reached(wall, policy_step, total_steps, ckpt, _ckpt_state, cfg):
+            break
         with timer("Time/env_interaction_time"):
             if policy_step >= learning_starts and actor_type != "task":
                 actor_type = "task"  # reference :330-331
@@ -277,20 +296,7 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
             cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
         ) or cfg.dry_run or policy_step >= total_steps:
             last_checkpoint = policy_step
-            ckpt_state = {
-                "params": params,
-                "actor_exploration": actor_exploration_params,
-                "opt_states": opt_states,
-                "moments": moments,
-                "ratio": ratio.state_dict(),
-                "policy_step": policy_step,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-                "rng": root_key,
-            }
-            if cfg.buffer.checkpoint:
-                ckpt_state["rb"] = rb.checkpoint_state_dict()
-            ckpt.save(policy_step, ckpt_state)
+            ckpt.save(policy_step, _ckpt_state())
 
     envs.close()
     if rank == 0 and cfg.algo.run_test:

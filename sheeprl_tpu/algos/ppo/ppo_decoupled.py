@@ -36,6 +36,7 @@ from ...data import ReplayBuffer
 from ...ops import gae as gae_op
 from ...optim import clipped
 from ...parallel import Distributed
+from ...parallel.placement import ParamMirror, player_device
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
@@ -98,8 +99,12 @@ def _player_loop(
             else None,
         )
 
-        params = init_params
-        root_key = seed_key
+        # per-step inference on the player device (host CPU when the mesh is
+        # a remote accelerator); ParamMirror's defensive copy keeps the
+        # trainer's donated buffers from dying under us on shared devices
+        pdev = player_device(cfg, dist.local_device)
+        mirror = ParamMirror(init_params, pdev)
+        root_key = jax.device_put(seed_key, pdev)
         obs, _ = envs.reset(seed=cfg.seed)
         policy_step = (start_iter - 1) * num_envs * rollout_steps
 
@@ -108,7 +113,7 @@ def _player_loop(
                 for _ in range(rollout_steps):
                     device_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
                     root_key, act_key = jax.random.split(root_key)
-                    actions, logprobs, values = act(params, device_obs, act_key)
+                    actions, logprobs, values = act(mirror.params, device_obs, act_key)
                     np_actions = np.asarray(actions)
                     if module.is_continuous:
                         env_actions = np_actions.reshape(num_envs, -1)
@@ -133,7 +138,8 @@ def _player_loop(
                         }
                         vals = np.asarray(
                             value_fn(
-                                params, prepare_obs(stacked, cnn_keys, mlp_keys, len(trunc_idx))
+                                mirror.params,
+                                prepare_obs(stacked, cnn_keys, mlp_keys, len(trunc_idx)),
                             )
                         )
                         rewards[trunc_idx] += cfg.algo.gamma * vals.reshape(-1, 1)
@@ -156,7 +162,7 @@ def _player_loop(
                         aggregator.update("Game/ep_len_avg", ep_len)
 
                 local = rb.buffer
-                next_value = value_fn(params, prepare_obs(obs, cnn_keys, mlp_keys, num_envs))
+                next_value = value_fn(mirror.params, prepare_obs(obs, cnn_keys, mlp_keys, num_envs))
                 returns, advantages = gae_fn(
                     jnp.asarray(local["rewards"]),
                     jnp.asarray(local["values"]),
@@ -172,9 +178,10 @@ def _player_loop(
             # hand the rollout to the trainer, wait for the new params
             # (reference scatter :294-299 + param broadcast :302-305)
             data_q.put((update_iter, policy_step, data))
-            params = params_q.get()
-            if params is None:  # trainer crashed
+            new_params = params_q.get()
+            if new_params is None:  # trainer crashed
                 break
+            mirror.refresh(new_params)
 
         envs.close()
         data_q.put(None)  # rollout source exhausted

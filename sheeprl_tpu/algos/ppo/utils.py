@@ -18,14 +18,16 @@ AGGREGATOR_KEYS = {
 MODELS_TO_REGISTER = {"agent"}
 
 
-def prepare_obs(obs: Dict[str, np.ndarray], cnn_keys=(), mlp_keys=(), num_envs: int = 1) -> Dict[str, jax.Array]:
-    """Host numpy obs → device arrays. Images stay uint8 NHWC (the encoder
-    normalizes); vectors become f32 (reference ppo/utils.py prepare_obs)."""
-    out: Dict[str, jax.Array] = {}
+def prepare_obs(obs: Dict[str, np.ndarray], cnn_keys=(), mlp_keys=(), num_envs: int = 1) -> Dict[str, np.ndarray]:
+    """Shape the host obs for the policy: images stay uint8 NHWC (the encoder
+    normalizes); vectors become f32 (reference ppo/utils.py prepare_obs).
+    Stays NUMPY — the jitted consumer transfers it to wherever its committed
+    params live (host player or mesh), so no eager default-device hop."""
+    out: Dict[str, np.ndarray] = {}
     for k in cnn_keys:
-        out[k] = jnp.asarray(obs[k]).reshape(num_envs, *obs[k].shape[-3:])
+        out[k] = np.asarray(obs[k]).reshape(num_envs, *np.asarray(obs[k]).shape[-3:])
     for k in mlp_keys:
-        out[k] = jnp.asarray(obs[k], dtype=jnp.float32).reshape(num_envs, -1)
+        out[k] = np.asarray(obs[k], dtype=np.float32).reshape(num_envs, -1)
     return out
 
 

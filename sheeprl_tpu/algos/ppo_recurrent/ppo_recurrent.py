@@ -34,13 +34,14 @@ from ...data import ReplayBuffer
 from ...ops import gae as gae_op
 from ...optim import clipped
 from ...parallel import Distributed
+from ...parallel.placement import make_param_mirror
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...utils.timer import timer
-from ...utils.utils import linear_annealing, save_configs
+from ...utils.utils import WallClockStopper, linear_annealing, save_configs, wall_cap_reached
 from ..ppo.loss import entropy_loss, policy_loss, value_loss
 from .agent import RecurrentPPOAgent, actions_and_log_probs, build_agent
 from .utils import AGGREGATOR_KEYS, prepare_obs, test
@@ -225,24 +226,42 @@ def main(dist: Distributed, cfg: Config) -> None:
             oh.append(np.eye(d, dtype=np.float32)[np_actions[:, i]])
         return np.concatenate(oh, axis=-1)
 
+    # per-step inference on the player device (host CPU when the mesh is a
+    # remote accelerator); blocking refresh keeps PPO strictly on-policy
+    mirror, pdev, player_key, root_key = make_param_mirror(
+        cfg, dist.local_device, params, root_key, allow_async=False
+    )
+
     obs, _ = envs.reset(seed=cfg.seed)
-    carry = module.initial_states(num_envs)
+    carry = jax.device_put(module.initial_states(num_envs), pdev)
     prev_actions = np.zeros((num_envs, act_width), np.float32)
 
+    def _ckpt_state():
+        return {
+            "params": params,
+            "opt_state": opt_state,
+            "update": update_iter,
+            "policy_step": policy_step,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng": root_key,
+        }
+
+    wall = WallClockStopper(cfg)
     for update_iter in range(start_iter, num_updates + 1):
         chunk_cx: list = []
         chunk_hx: list = []
         with timer("Time/env_interaction_time"):
             for t in range(rollout_steps):
                 device_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
-                root_key, act_key = jax.random.split(root_key)
+                player_key, act_key = jax.random.split(player_key)
                 if t % seq_len == 0:
                     # only chunk-start states seed training sequences — no
                     # per-step device→host carry copies
                     chunk_cx.append(np.asarray(carry[0]))
                     chunk_hx.append(np.asarray(carry[1]))
                 actions, logprobs, values, carry = act(
-                    params, device_obs, jnp.asarray(prev_actions)[None], carry, act_key
+                    mirror.current(), device_obs, prev_actions[None], carry, act_key
                 )
                 np_actions = np.asarray(actions)
                 if module.is_continuous:
@@ -268,14 +287,14 @@ def main(dist: Distributed, cfg: Config) -> None:
                         for k in obs_keys
                     }
                     sub_carry = (
-                        jnp.asarray(np.asarray(carry[0])[trunc_idx]),
-                        jnp.asarray(np.asarray(carry[1])[trunc_idx]),
+                        np.asarray(carry[0])[trunc_idx],
+                        np.asarray(carry[1])[trunc_idx],
                     )
                     vals = np.asarray(
                         value_fn(
-                            params,
+                            mirror.current(),
                             prepare_obs(stacked, cnn_keys, mlp_keys, len(trunc_idx)),
-                            jnp.asarray(actions_oh[trunc_idx])[None],
+                            actions_oh[trunc_idx][None],
                             sub_carry,
                         )
                     )
@@ -295,7 +314,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                 # host-side resets between steps (reference :357-374)
                 prev_actions = (1.0 - dones) * actions_oh
                 if reset_on_done and np.any(dones):
-                    keep = jnp.asarray(1.0 - dones)
+                    keep = 1.0 - dones  # numpy: carry stays on the player device
                     carry = (carry[0] * keep, carry[1] * keep)
 
                 obs = next_obs
@@ -305,10 +324,12 @@ def main(dist: Distributed, cfg: Config) -> None:
 
         with timer("Time/train_time"):
             local = rb.buffer  # [T, N, ...]
+            # mirror params: the recurrent carry lives on the player device,
+            # and mixing it with mesh-committed params would be a device clash
             next_value = value_fn(
-                params,
+                mirror.current(),
                 prepare_obs(obs, cnn_keys, mlp_keys, num_envs),
-                jnp.asarray(prev_actions)[None],
+                prev_actions[None],
                 carry,
             )
             returns, advantages = gae_fn(
@@ -366,6 +387,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             }
             root_key, up_key = jax.random.split(root_key)
             params, opt_state, metrics = update(params, opt_state, data, coefs, up_key)
+            mirror.refresh(params)  # blocking: next rollout acts with fresh params
 
         for k, v in metrics.items():
             aggregator.update(k, np.asarray(v))
@@ -395,18 +417,10 @@ def main(dist: Distributed, cfg: Config) -> None:
             cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
         ) or cfg.dry_run or update_iter == num_updates:
             last_checkpoint = policy_step
-            ckpt.save(
-                policy_step,
-                {
-                    "params": params,
-                    "opt_state": opt_state,
-                    "update": update_iter,
-                    "policy_step": policy_step,
-                    "last_log": last_log,
-                    "last_checkpoint": last_checkpoint,
-                    "rng": root_key,
-                },
-            )
+            ckpt.save(policy_step, _ckpt_state())
+
+        if wall_cap_reached(wall, policy_step, int(cfg.algo.total_steps), ckpt, _ckpt_state, cfg):
+            break
 
     envs.close()
     if rank == 0 and cfg.algo.run_test:

@@ -20,13 +20,14 @@ MODELS_TO_REGISTER = {"agent"}
 def prepare_obs(
     obs: Dict[str, np.ndarray], cnn_keys=(), mlp_keys=(), num_envs: int = 1
 ) -> Dict[str, jax.Array]:
-    """Host obs → device with a leading sequence axis of 1 ([1, N, ...],
-    reference ppo_recurrent/utils.py prepare_obs)."""
-    out: Dict[str, jax.Array] = {}
+    """Host obs shaped with a leading sequence axis of 1 ([1, N, ...],
+    reference ppo_recurrent/utils.py prepare_obs). Stays NUMPY — the jitted
+    consumer transfers it to wherever its committed params live."""
+    out: Dict[str, np.ndarray] = {}
     for k in cnn_keys:
-        out[k] = jnp.asarray(obs[k]).reshape(1, num_envs, *np.asarray(obs[k]).shape[-3:])
+        out[k] = np.asarray(obs[k]).reshape(1, num_envs, *np.asarray(obs[k]).shape[-3:])
     for k in mlp_keys:
-        out[k] = jnp.asarray(obs[k], dtype=jnp.float32).reshape(1, num_envs, -1)
+        out[k] = np.asarray(obs[k], dtype=np.float32).reshape(1, num_envs, -1)
     return out
 
 

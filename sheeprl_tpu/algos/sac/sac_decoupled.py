@@ -26,6 +26,7 @@ import numpy as np
 from ...config import Config, instantiate
 from ...data import ReplayBuffer
 from ...parallel import Distributed
+from ...parallel.placement import ParamMirror, player_device
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
@@ -85,8 +86,12 @@ def _player_loop(
         if state and "ratio" in state:
             ratio.load_state_dict(state["ratio"])
 
-        actor_params = init_actor_params
-        root_key = seed_key
+        # per-step inference on the player device (host CPU when the mesh is
+        # a remote accelerator); ParamMirror's defensive copy keeps the
+        # trainer's donated buffers from dying under us on shared devices
+        pdev = player_device(cfg)
+        mirror = ParamMirror(init_actor_params, pdev)
+        root_key = jax.device_put(seed_key, pdev)
         total_steps = int(cfg.algo.total_steps) if not cfg.dry_run else num_envs
         learning_starts = int(cfg.algo.learning_starts) if not cfg.dry_run else 0
         policy_step = state["policy_step"] if state else 0
@@ -101,7 +106,7 @@ def _player_loop(
                 else:
                     root_key, k = jax.random.split(root_key)
                     env_actions = np.asarray(
-                        act(actor_params, jnp.asarray(obs_vec), k)
+                        act(mirror.params, obs_vec, k)
                     ).reshape(num_envs, act_dim)
                 next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
                 policy_step += num_envs
@@ -147,9 +152,10 @@ def _player_loop(
                     data_q.put(
                         (policy_step, per_rank_gradient_steps, batches, ratio.state_dict(), rb)
                     )
-                    actor_params = params_q.get()
-                    if actor_params is None:
+                    new_actor_params = params_q.get()
+                    if new_actor_params is None:
                         break
+                    mirror.refresh(new_actor_params)
 
         envs.close()
         data_q.put(None)

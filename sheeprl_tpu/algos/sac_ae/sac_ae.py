@@ -24,13 +24,14 @@ import optax
 from ...config import Config, instantiate
 from ...data import ReplayBuffer
 from ...parallel import Distributed
+from ...parallel.placement import make_param_mirror
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...utils.timer import timer
-from ...utils.utils import Ratio, save_configs
+from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
 from ..sac.loss import critic_loss, entropy_loss, policy_loss
 from .agent import build_agent
 from .utils import AGGREGATOR_KEYS, preprocess_obs, prepare_obs_np, sample_actions_features, test
@@ -254,16 +255,39 @@ def main(dist: Distributed, cfg: Config) -> None:
     last_log = state["last_log"] if state else 0
     last_checkpoint = state["last_checkpoint"] if state else 0
 
+    # per-step inference on the player device (host CPU when the mesh is a
+    # remote accelerator); mirror re-syncs encoder+actor after a train burst
+    mirror, pdev, player_key, root_key = make_param_mirror(
+        cfg, dist.local_device, {"encoder": params["encoder"], "actor": params["actor"]}, root_key
+    )
+
     obs, _ = envs.reset(seed=cfg.seed)
 
+    def _ckpt_state():
+        s = {
+            "params": params,
+            "opt_states": opt_states,
+            "ratio": ratio.state_dict(),
+            "policy_step": policy_step,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng": root_key,
+        }
+        if cfg.buffer.checkpoint:
+            s["rb"] = rb.checkpoint_state_dict()
+        return s
+
+    wall = WallClockStopper(cfg)
     while policy_step < total_steps:
+        if wall_cap_reached(wall, policy_step, total_steps, ckpt, _ckpt_state, cfg):
+            break
         with timer("Time/env_interaction_time"):
             if policy_step <= learning_starts:
                 env_actions = np.stack([action_space.sample() for _ in range(num_envs)])
             else:
-                root_key, k = jax.random.split(root_key)
+                player_key, k = jax.random.split(player_key)
                 device_obs = prepare_obs_np(obs, cnn_keys, mlp_keys, num_envs, normalize=True)
-                env_actions = np.asarray(act(params, device_obs, k)).reshape(num_envs, act_dim)
+                env_actions = np.asarray(act(mirror.current(), device_obs, k)).reshape(num_envs, act_dim)
             next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
             policy_step += num_envs
 
@@ -307,6 +331,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                     root_key, sub = jax.random.split(root_key)
                     keys = jax.random.split(sub, g)
                     params, opt_states, metrics = train(params, opt_states, batches, keys)
+                    mirror.refresh({"encoder": params["encoder"], "actor": params["actor"]})
                 for k, v in metrics.items():
                     aggregator.update(k, np.asarray(v))
 
@@ -320,18 +345,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
         ) or cfg.dry_run or policy_step >= total_steps:
             last_checkpoint = policy_step
-            ckpt_state = {
-                "params": params,
-                "opt_states": opt_states,
-                "ratio": ratio.state_dict(),
-                "policy_step": policy_step,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-                "rng": root_key,
-            }
-            if cfg.buffer.checkpoint:
-                ckpt_state["rb"] = rb.checkpoint_state_dict()
-            ckpt.save(policy_step, ckpt_state)
+            ckpt.save(policy_step, _ckpt_state())
 
     envs.close()
     if rank == 0 and cfg.algo.run_test:

@@ -39,12 +39,13 @@ def sample_actions_features(actor, mean, log_std, key, greedy: bool = False):
 
 
 def prepare_obs_np(obs: Dict[str, np.ndarray], cnn_keys, mlp_keys, num_envs: int, normalize: bool = False):
+    # stays numpy: the jitted consumer places it next to its committed params
     out = {}
     for k in cnn_keys:
-        x = jnp.asarray(np.asarray(obs[k]).reshape(num_envs, *np.asarray(obs[k]).shape[-3:]))
-        out[k] = x.astype(jnp.float32) / 255.0 if normalize else x
+        x = np.asarray(obs[k]).reshape(num_envs, *np.asarray(obs[k]).shape[-3:])
+        out[k] = x.astype(np.float32) / 255.0 if normalize else x
     for k in mlp_keys:
-        out[k] = jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1))
+        out[k] = np.asarray(obs[k], np.float32).reshape(num_envs, -1)
     return out
 
 

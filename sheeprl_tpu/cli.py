@@ -119,12 +119,16 @@ def run_algorithm(cfg: Config) -> None:
                 cfg.set_path(f"env.{k}", exploration_cfg.select(f"env.{k}"))
         kwargs["exploration_cfg"] = exploration_cfg
     dist = build_distributed(cfg)
-    if cfg.select("metric.log_level", 1) == 0:
-        from .utils.metric import MetricAggregator
+    # class-level switches are assigned both ways so a run never inherits
+    # them from an earlier run in the same process (reference runs are
+    # one-process-per-run; in-process callers like tests are not)
+    from .utils.metric import MetricAggregator
 
-        MetricAggregator.disabled = True
-    if cfg.select("metric.disable_timer", False):
-        timer.disabled = True
+    MetricAggregator.disabled = cfg.select("metric.log_level", 1) == 0
+    timer.disabled = bool(cfg.select("metric.disable_timer", False))
+    import contextlib
+
+    ctx: Any = contextlib.nullcontext()
     if cfg.select("metric.profiler.enabled", False):
         # XLA-level trace of the whole run (device programs, transfers and
         # host gaps), viewable in TensorBoard's profiler tab — the tool for
@@ -135,10 +139,9 @@ def run_algorithm(cfg: Config) -> None:
             cfg.select("metric.profiler.trace_dir")
             or f"logs/profiler/{cfg.root_dir}/{cfg.run_name}"  # unique per run
         )
-        with jax.profiler.trace(trace_dir):
-            fn(dist, cfg, **kwargs)
-        return
-    fn(dist, cfg, **kwargs)
+        ctx = jax.profiler.trace(trace_dir)
+    with ctx:
+        fn(dist, cfg, **kwargs)
 
 
 def eval_algorithm(cfg: Config) -> None:

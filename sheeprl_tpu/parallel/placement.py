@@ -83,11 +83,27 @@ class ParamMirror:
     def __init__(self, params: Any, device: Any, async_refresh: bool = False):
         self.device = device
         self.async_refresh = bool(async_refresh)
-        self.params = jax.device_put(params, device)
+        self.params = self._put(params)
         self._pending: Optional[Any] = None
 
+    def _put(self, params: Any) -> Any:
+        """Copy params to the mirror device. ``device_put`` ALIASES an array
+        that already lives on the target device — and the learner's train
+        step donates its param buffers, which would delete the mirror's copy
+        out from under the player (single-device CPU runs, where learner and
+        player share cpu:0). Force a real on-device copy for those leaves."""
+
+        def put_leaf(x: Any) -> Any:
+            if isinstance(x, jax.Array) and x.devices() == {self.device}:
+                import jax.numpy as jnp
+
+                return jnp.copy(x)  # new buffer on the same device
+            return jax.device_put(x, self.device)
+
+        return jax.tree.map(put_leaf, params)
+
     def refresh(self, params: Any) -> None:
-        new = jax.device_put(params, self.device)
+        new = self._put(params)
         if self.async_refresh:
             self._pending = new
         else:
@@ -103,17 +119,23 @@ class ParamMirror:
                 self.params, self._pending = self._pending, None
         return self.params
 
-def make_param_mirror(cfg: Any, accelerator: Any, params: Any, root_key: Any):
+def make_param_mirror(cfg: Any, accelerator: Any, params: Any, root_key: Any, allow_async: bool = True):
     """The per-algorithm player setup, in one place: resolve the player
     device, mirror the player's param subtree there, and derive a player PRNG
     key committed next to it (so the env loop never does a host-side split).
+
+    ``allow_async=False`` pins the mirror to blocking refresh regardless of
+    ``algo.player.async_refresh`` — on-policy algorithms (PPO/A2C) must act
+    with the params the coming update will be credited to.
 
     Returns ``(mirror, pdev, player_key, root_key)`` — the new ``root_key``
     replaces the caller's (one split is consumed).
     """
     pdev = player_device(cfg, accelerator)
     mirror = ParamMirror(
-        params, pdev, async_refresh=bool(cfg.select("algo.player.async_refresh", False))
+        params,
+        pdev,
+        async_refresh=allow_async and bool(cfg.select("algo.player.async_refresh", False)),
     )
     root_key, pk = jax.random.split(root_key)
     return mirror, pdev, jax.device_put(pk, pdev), root_key

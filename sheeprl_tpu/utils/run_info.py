@@ -1,0 +1,13 @@
+"""Post-run facts for in-process callers.
+
+``wall_cap_reached`` (utils/utils.py) records where a wall-capped run
+actually stopped — ``policy_step`` short of ``total_steps``, plus a
+``wall_capped`` flag. A run that completes normally records nothing
+(callers fall back to the configured step count). The bench driver reads
+this to compute SPS over the steps that really ran; the CLI never needs it.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+last_run: Dict[str, Any] = {}

@@ -93,3 +93,63 @@ def dotdict(d: Any) -> Any:
     from ..config import Config
 
     return Config(d) if not isinstance(d, Config) else d
+
+
+class WallClockStopper:
+    """`algo.max_wall_time_s` support: stop training cleanly at a step
+    boundary once the wall-clock budget is spent (bench legs running under an
+    external kill budget report SPS over the steps that actually ran).
+
+    Single-host only: each process consults its own clock, so under
+    multi-host SPMD one rank could break out while another enters a
+    cross-host collective and deadlock — the knob is ignored (with a
+    warning) when `jax.process_count() > 1`.
+    """
+
+    def __init__(self, cfg: Any):
+        import sys
+        import time
+
+        import jax
+
+        self.max_s = float(cfg.select("algo.max_wall_time_s", -1) or -1)
+        if self.max_s > 0 and jax.process_count() > 1:
+            print(
+                "[wall-time] algo.max_wall_time_s ignored: rank-local clocks can't "
+                "coordinate a multi-host stop (use total_steps)",
+                file=sys.stderr,
+            )
+            self.max_s = -1.0
+        self._t0 = time.perf_counter()
+
+    def expired(self, policy_step: int, total_steps: int) -> bool:
+        import sys
+        import time
+
+        if self.max_s <= 0:
+            return False
+        elapsed = time.perf_counter() - self._t0
+        if elapsed <= self.max_s:
+            return False
+        print(
+            f"[wall-time] stopping at step {policy_step}/{total_steps} after {elapsed:.1f}s",
+            file=sys.stderr,
+            flush=True,
+        )
+        return True
+
+
+def wall_cap_reached(wall: "WallClockStopper", policy_step: int, total_steps: int, ckpt, state_fn, cfg) -> bool:
+    """Shared wall-cap stop policy for training loops: when the budget is
+    spent, write the final checkpoint (iff `checkpoint.save_last` — the knob
+    that means "checkpoint on exit"), record where the run actually stopped
+    for in-process callers (utils/run_info.py — the bench computes SPS over
+    the steps that really ran), and tell the caller to break."""
+    if not wall.expired(policy_step, total_steps):
+        return False
+    if cfg.checkpoint.save_last:
+        ckpt.save(policy_step, state_fn())
+    from . import run_info
+
+    run_info.last_run.update(policy_step=policy_step, total_steps=total_steps, wall_capped=True)
+    return True

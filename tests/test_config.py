@@ -48,3 +48,19 @@ def test_instantiate_target():
     assert obj["a"] == 1
     fn = instantiate({"_target_": "operator.add", "_partial_": True})
     assert fn(2, 3) == 5
+
+
+def test_metric_switches_do_not_leak_across_runs(standard_args):
+    """A run with metric.log_level=0 must not disable metrics for later runs
+    in the same process (the reference is one-process-per-run; in-process
+    callers like this suite are not)."""
+    from sheeprl_tpu.cli import run
+    from sheeprl_tpu.utils.metric import MetricAggregator
+    from sheeprl_tpu.utils.timer import timer
+
+    run(["exp=ppo", "env=dummy", "env.id=discrete_dummy", "metric.disable_timer=True"] + standard_args)
+    assert MetricAggregator.disabled and timer.disabled
+    args2 = [a for a in standard_args if not a.startswith(("metric.log_level", "checkpoint.save_last"))]
+    run(["exp=ppo", "env=dummy", "env.id=discrete_dummy", "metric.log_level=1", "checkpoint.save_last=False"] + args2)
+    assert not MetricAggregator.disabled
+    assert not timer.disabled
