@@ -617,7 +617,10 @@ def main(dist: Distributed, cfg: Config) -> None:
                         jax.random.split(sub, per_rank_gradient_steps),
                     )
                 # metrics stay on device until log time — no per-step host sync
-                pending_metrics.append(metrics)
+                if not MetricAggregator.disabled:
+                    # device refs held until the log-cadence host sync;
+                    # skip entirely when metrics are off (bench legs)
+                    pending_metrics.append(metrics)
                 mirror.refresh({"wm": params["wm"], "actor": params["actor"]})
             if policy_step < total_steps:
                 # overlap the next sample + host→HBM transfer with the train

@@ -556,7 +556,10 @@ def main(dist: Distributed, cfg: Config) -> None:
                         batches,
                         jax.random.split(sub, per_rank_gradient_steps),
                     )
-                pending_metrics.append(metrics)
+                if not MetricAggregator.disabled:
+                    # device refs held until the log-cadence host sync;
+                    # skip entirely when metrics are off (bench legs)
+                    pending_metrics.append(metrics)
                 mirror.refresh(_sp())
             if policy_step < total_steps:
                 prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))

@@ -272,7 +272,10 @@ def main(dist: Distributed, cfg: Config) -> None:
                     keys = jax.random.split(sub, per_rank_gradient_steps)
                     params, opt_states, metrics = train(params, opt_states, batches, keys)
                     cumulative_grad_steps += per_rank_gradient_steps
-                pending_metrics.append(metrics)
+                if not MetricAggregator.disabled:
+                    # device refs held until the log-cadence host sync;
+                    # skip entirely when metrics are off (bench legs)
+                    pending_metrics.append(metrics)
                 mirror.refresh({"actor": params["actor"]})
             if policy_step < total_steps:
                 prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))

@@ -476,6 +476,7 @@ class EpisodeBuffer:
         self._episodes: List[Dict[str, np.ndarray]] = []
         self._open: List[Optional[Dict[str, List[np.ndarray]]]] = [None] * n_envs
         self._cum_len = 0
+        self._episode_counter = 0  # distinct memmap dir per committed episode
 
     @property
     def buffer(self) -> List[Dict[str, np.ndarray]]:
@@ -541,12 +542,48 @@ class EpisodeBuffer:
                 f"Episode of length {length} exceeds buffer_size {self._buffer_size}"
             )
         ep = {k: np.stack(v, axis=0) for k, v in open_ep.items() if v}
+        if self._memmap:
+            ep = self._memmap_episode(ep)
         self._episodes.append(ep)
         self._cum_len += length
         # evict oldest full episodes (reference :993-1014)
         while self._cum_len > self._buffer_size and self._episodes:
             old = self._episodes.pop(0)
             self._cum_len -= len(next(iter(old.values())))
+            self._drop_episode_dir(old)
+
+    def _memmap_episode(self, ep: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """Move a committed episode to disk (reference buffers.py:969-991
+        memmaps each episode) so huge buffers don't occupy RAM."""
+        ep_dir = None
+        if self._memmap_dir is not None:
+            ep_dir = self._memmap_dir / f"episode_{self._episode_counter}"
+        self._episode_counter += 1
+        return {
+            k: MemmapArray.from_array(v, filename=None if ep_dir is None else ep_dir / f"{k}.memmap")
+            for k, v in ep.items()
+        }
+
+    def _drop_episode_dir(self, old: Dict[str, Any]) -> None:
+        """Deterministically release an evicted episode: dropping the last
+        refs unlinks owned memmap files (MemmapArray.__del__); the now-empty
+        per-episode directory is removed too, so long runs don't accumulate
+        unbounded empty dirs."""
+        if not self._memmap or self._memmap_dir is None:
+            return
+        first = next(iter(old.values()), None)
+        ep_dir = (
+            Path(first.filename).parent
+            if isinstance(first, MemmapArray) and first.filename is not None
+            else None
+        )
+        old.clear()
+        del first
+        if ep_dir is not None:
+            try:
+                ep_dir.rmdir()
+            except OSError:
+                pass
 
     def sample(
         self,
@@ -600,7 +637,8 @@ class EpisodeBuffer:
 
     def state_dict(self) -> Dict[str, Any]:
         return {
-            "episodes": [{k: v.copy() for k, v in ep.items()} for ep in self._episodes],
+            # np.array() also materializes memmap-backed episodes
+            "episodes": [{k: np.array(v) for k, v in ep.items()} for ep in self._episodes],
             "open": [
                 None if o is None else {k: [x.copy() for x in v] for k, v in o.items()}
                 for o in self._open
@@ -617,7 +655,12 @@ class EpisodeBuffer:
         return state
 
     def load_state_dict(self, state: Dict[str, Any]) -> "EpisodeBuffer":
-        self._episodes = state["episodes"]
+        episodes = state["episodes"]
+        if self._memmap:
+            # a memmap buffer stays disk-backed across resume (ReplayBuffer
+            # likewise reloads into its memmap storage)
+            episodes = [self._memmap_episode({k: np.asarray(v) for k, v in ep.items()}) for ep in episodes]
+        self._episodes = episodes
         self._open = state["open"]
         self._cum_len = int(state["cum_len"])
         return self
