@@ -32,9 +32,11 @@ Robustness contract (the round-2 run broke it — BENCH_r02 rc=124):
   printed as the headline (with `e2e_error` noting why), so the driver
   always gets a parseable last line.
 
-Subcommands: `ppo` (reference CartPole wall-clock recipe, 81.27 s baseline),
+Subcommands: `ppo` / `a2c` (reference CartPole wall-clock recipes, 81.27 s /
+84.76 s baselines), `sac` (LunarLanderContinuous, 320.21 s baseline),
 `dv1` / `dv2` / `dv3` (the reference Dreamer micro-benches, 2207.13 s /
 906.42 s / 1589.30 s baselines), `dv3_step` (compute-only only).
+`BENCH_RECIPE_WALL_S` wall-caps the ppo/a2c/sac legs.
 `BENCH_DREAMER_STEPS` overrides the 16_384-step count (debugging only — the
 recorded `vs_baseline` stays an SPS ratio either way).
 """
@@ -49,8 +51,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-PPO_BASELINE_SECONDS = 81.27  # reference README.md:97-112 (v0.5.5, 4 CPU)
-PPO_TOTAL_STEPS = 65_536
+# reference README.md:97-148 (v0.5.5, 4 CPU): 65_536-step wall-clock recipes
+RECIPE_BASELINE_SECONDS = {"ppo": 81.27, "a2c": 84.76, "sac": 320.21}
+RECIPE_EXPS = {"ppo": "ppo_benchmarks", "a2c": "a2c_benchmarks", "sac": "sac_benchmarks"}
+RECIPE_TOTAL_STEPS = 65_536
 
 # reference README.md:150-176 (v0.5.5, 4 CPU): 16_384-step micro-benches
 DREAMER_BASELINE_SECONDS = {"dv1": 2207.13, "dv2": 906.42, "dv3": 1589.30}
@@ -62,84 +66,87 @@ DREAMER_EXPS = {
 DREAMER_TOTAL_STEPS = int(os.environ.get("BENCH_DREAMER_STEPS", 16_384))
 
 
-def bench_ppo() -> dict:
+def _timed_cli_run(args: list, steps: int, baseline_seconds: float, baseline_steps: int, metric: str) -> dict:
+    """Run a recipe through the CLI (training output → stderr), timing it and
+    accounting for a wall-cap stop: SPS is computed over the steps that
+    actually ran (utils/run_info.py records a short stop)."""
     from sheeprl_tpu.cli import run
+    from sheeprl_tpu.utils import run_info
 
+    run_info.last_run.clear()  # don't inherit a previous leg's policy_step
     t0 = time.perf_counter()
     with contextlib.redirect_stdout(sys.stderr):
-        run(
-            [
-                "exp=ppo_benchmarks",
-                f"algo.total_steps={PPO_TOTAL_STEPS}",
-            ]
-        )
+        run(args)
     elapsed = time.perf_counter() - t0
-    sps = PPO_TOTAL_STEPS / elapsed
-    baseline_sps = PPO_TOTAL_STEPS / PPO_BASELINE_SECONDS
-    return {
-        "metric": "PPO CartPole-v1 65536-step policy SPS (reference recipe, end-to-end)",
+    recorded = run_info.last_run.get("policy_step")  # set only on wall-cap stop
+    steps_done = steps if recorded is None else int(recorded)
+    sps = steps_done / elapsed
+    rec = {
+        "metric": metric,
         "value": round(sps, 2),
         "unit": "env steps/sec",
-        "vs_baseline": round(sps / baseline_sps, 3),
+        "vs_baseline": round(sps / (baseline_steps / baseline_seconds), 3),
         "elapsed_seconds": round(elapsed, 2),
-        "baseline_seconds": PPO_BASELINE_SECONDS,
+        "baseline_seconds": baseline_seconds,
+        "steps": steps_done,
     }
+    if steps_done < steps:
+        rec["wall_capped"] = True
+    return rec
+
+
+def bench_recipe(which: str) -> dict:
+    """One of the reference's 65_536-step wall-clock recipes end to end:
+    ppo / a2c (CartPole) or sac (LunarLanderContinuous)."""
+    steps = RECIPE_TOTAL_STEPS
+    args = [f"exp={RECIPE_EXPS[which]}", f"algo.total_steps={steps}"]
+    wall_cap = os.environ.get("BENCH_RECIPE_WALL_S")
+    if wall_cap:
+        args.append(f"algo.max_wall_time_s={wall_cap}")
+    env_name = "LunarLanderContinuous" if which == "sac" else "CartPole-v1"
+    return _timed_cli_run(
+        args,
+        steps,
+        RECIPE_BASELINE_SECONDS[which],
+        steps,
+        f"{which.upper()} {env_name} {steps}-step policy SPS (reference recipe, end-to-end)",
+    )
 
 
 def bench_dreamer_e2e(which: str) -> dict:
     """The reference's 16_384-step Dreamer micro-bench, end to end through
     the CLI (env stepping + replay + prefetch + train), dummy Atari shapes.
-    Training/config output goes to stderr; the caller prints the JSON.
 
     The run carries its own wall-clock cap (`algo.max_wall_time_s`,
     BENCH_E2E_WALL_S, default 950 s): if the machine is slower than expected
     it stops cleanly at a step boundary and the SPS is computed over the
     steps that actually ran, instead of the subprocess being killed with
     nothing on stdout."""
-    from sheeprl_tpu.cli import run
-    from sheeprl_tpu.utils import run_info
-
     steps = DREAMER_TOTAL_STEPS
     wall_cap = float(os.environ.get("BENCH_E2E_WALL_S", 950))
-    run_info.last_run.clear()  # don't inherit a previous leg's policy_step
-    t0 = time.perf_counter()
-    with contextlib.redirect_stdout(sys.stderr):
-        run(
-            [
-                f"exp={DREAMER_EXPS[which]}",
-                "env=dummy",
-                "env.id=discrete_dummy",
-                "algo.cnn_keys.encoder=[rgb]",
-                "algo.mlp_keys.encoder=[]",
-                f"algo.total_steps={steps}",
-                f"algo.max_wall_time_s={wall_cap}",
-                f"buffer.size={steps}",
-                "buffer.checkpoint=False",
-                "buffer.memmap=False",
-                "checkpoint.every=0",
-                "checkpoint.save_last=False",
-                "metric.log_level=0",
-                "algo.player.async_refresh=True",
-            ]
-        )
-    elapsed = time.perf_counter() - t0
-    recorded = run_info.last_run.get("policy_step")  # set only on wall-cap stop
-    steps_done = steps if recorded is None else int(recorded)
-    sps = steps_done / elapsed
-    baseline_sps = DREAMER_TOTAL_STEPS_REF / DREAMER_BASELINE_SECONDS[which]
-    rec = {
-        "metric": f"Dreamer{which.upper().replace('DV', 'V')} {steps}-step micro-bench policy "
+    return _timed_cli_run(
+        [
+            f"exp={DREAMER_EXPS[which]}",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            f"algo.total_steps={steps}",
+            f"algo.max_wall_time_s={wall_cap}",
+            f"buffer.size={steps}",
+            "buffer.checkpoint=False",
+            "buffer.memmap=False",
+            "checkpoint.every=0",
+            "checkpoint.save_last=False",
+            "metric.log_level=0",
+            "algo.player.async_refresh=True",
+        ],
+        steps,
+        DREAMER_BASELINE_SECONDS[which],
+        DREAMER_TOTAL_STEPS_REF,
+        f"Dreamer{which.upper().replace('DV', 'V')} {steps}-step micro-bench policy "
         "SPS (reference recipe end-to-end: env+replay+train, dummy Atari shapes, ckpt off)",
-        "value": round(sps, 2),
-        "unit": "env steps/sec",
-        "vs_baseline": round(sps / baseline_sps, 3),
-        "elapsed_seconds": round(elapsed, 2),
-        "baseline_seconds": DREAMER_BASELINE_SECONDS[which],
-        "steps": steps_done,
-    }
-    if steps_done < steps:
-        rec["wall_capped"] = True
-    return rec
+    )
 
 
 DREAMER_TOTAL_STEPS_REF = 16_384  # the baseline recipe's step count
@@ -190,8 +197,8 @@ def bench_preflight() -> dict:
 
 def main() -> None:
     arg = sys.argv[1] if len(sys.argv) > 1 else ""
-    if arg == "ppo":
-        print(json.dumps(bench_ppo()))
+    if arg in RECIPE_EXPS:
+        print(json.dumps(bench_recipe(arg)))
     elif arg in DREAMER_EXPS:
         print(json.dumps(bench_dreamer_e2e(arg)))
     elif arg == "preflight":
