@@ -1,0 +1,70 @@
+"""The bench driver's output contract: the LAST stdout line is always one
+parseable JSON record with metric/value/unit/vs_baseline — even when legs
+fail (bench.py's robustness contract; round-2 regression was rc=124 with
+config noise as the last line)."""
+import io
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+def _capture_main(monkeypatch, records):
+    """Run bench.main() with _run_subprocess_record stubbed; return parsed
+    last stdout line."""
+    calls = []
+
+    def fake_run(argv, budget):
+        calls.append(argv)
+        return records.get(argv[0])
+
+    monkeypatch.setattr(bench, "_run_subprocess_record", fake_run)
+    monkeypatch.delenv("SHEEPRL_TPU_PROGRESS", raising=False)  # main() setdefaults it
+    monkeypatch.setenv("SHEEPRL_TPU_PROGRESS", "0")
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    bench.main()
+    sys.stdout = sys.__stdout__
+    lines = [ln for ln in out.getvalue().strip().splitlines() if ln.strip()]
+    assert lines, "bench.main() printed nothing"
+    return json.loads(lines[-1]), calls
+
+
+REQUIRED = {"metric", "value", "unit", "vs_baseline"}
+
+
+def test_headline_is_e2e_with_step_extra(monkeypatch):
+    step = {"metric": "step", "value": 1000.0, "unit": "steps/s", "vs_baseline": 500.0}
+    e2e = {"metric": "e2e", "value": 100.0, "unit": "env steps/sec", "vs_baseline": 10.0}
+    rec, calls = _capture_main(
+        monkeypatch, {"preflight": {"ok": True}, "dv3_step": step, "dv3": e2e}
+    )
+    assert REQUIRED <= rec.keys()
+    assert rec["metric"] == "e2e"
+    assert rec["extra_metrics"][0]["metric"] == "step"
+    assert [c[0] for c in calls] == ["preflight", "dv3_step", "dv3"]
+
+
+def test_step_record_promoted_when_e2e_fails(monkeypatch):
+    step = {"metric": "step", "value": 1000.0, "unit": "steps/s", "vs_baseline": 500.0}
+    rec, _ = _capture_main(monkeypatch, {"preflight": {"ok": True}, "dv3_step": step})
+    assert REQUIRED <= rec.keys()
+    assert rec["metric"] == "step"
+    assert "e2e_error" in rec
+
+
+def test_error_record_when_everything_fails(monkeypatch):
+    rec, _ = _capture_main(monkeypatch, {"preflight": {"ok": True}})
+    assert REQUIRED <= rec.keys()
+    assert rec["vs_baseline"] == 0.0
+    assert "error" in rec
+
+
+def test_dead_device_link_fails_fast(monkeypatch):
+    rec, calls = _capture_main(monkeypatch, {})  # preflight returns None
+    assert REQUIRED <= rec.keys()
+    assert rec["vs_baseline"] == 0.0
+    assert "preflight" in rec["error"]
+    assert [c[0] for c in calls] == ["preflight"]  # expensive legs never ran

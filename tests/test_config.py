@@ -64,3 +64,17 @@ def test_metric_switches_do_not_leak_across_runs(standard_args):
     run(["exp=ppo", "env=dummy", "env.id=discrete_dummy", "metric.log_level=1", "checkpoint.save_last=False"] + args2)
     assert not MetricAggregator.disabled
     assert not timer.disabled
+
+
+@pytest.mark.parametrize("size", ["XS", "S", "M", "L", "XL"])
+def test_dreamer_v3_size_configs_compose(size):
+    """All five reference size presets compose (reference
+    configs/algo/dreamer_v3_{XS..XL}.yaml) with consistent interpolations."""
+    cfg = compose("config", [f"exp=dreamer_v3", f"algo=dreamer_v3_{size}", "env=dummy"])
+    wm = cfg.algo.world_model
+    assert cfg.algo.name == "dreamer_v3"
+    assert int(wm.recurrent_model.recurrent_state_size) > 0
+    assert int(wm.stochastic_size) > 0 and int(wm.discrete_size) > 0
+    # larger presets are monotonically wider in the recurrent state
+    sizes = {"XS": 256, "S": 512, "M": 1024, "L": 2048, "XL": 4096}
+    assert int(wm.recurrent_model.recurrent_state_size) == sizes[size]
