@@ -44,12 +44,16 @@ def test(module: Any, params: Any, env: Any, cfg: Any, log_dir: str, logger=None
         actions, _, _ = actions_and_log_probs(actor_out, module.is_continuous, greedy=True)
         return actions
 
+    from ...parallel.placement import place_for_inference
+
+    params_arg = place_for_inference(cfg, params)
+
     done = False
     cumulative_rew = 0.0
     obs, _ = env.reset(seed=cfg.seed)
     while not done:
         torch_obs = prepare_obs(obs, cnn_keys, mlp_keys, 1)
-        actions = np.asarray(act(params, torch_obs))
+        actions = np.asarray(act(params_arg, torch_obs))
         if module.is_continuous:
             env_actions = actions.reshape(env.action_space.shape)
         elif actions.shape[-1] > 1:

@@ -48,23 +48,28 @@ def test(module: Any, params: Any, env: Any, cfg: Any, log_dir: str, logger=None
         actions, _, _ = actions_and_log_probs(actor_out, module.is_continuous, greedy=True)
         return actions, carry
 
+    from ...parallel.placement import place_for_inference, player_device
+
+    pdev = player_device(cfg)
+    params = place_for_inference(cfg, params)
+
     done = False
     cumulative_rew = 0.0
     obs, _ = env.reset(seed=cfg.seed)
-    carry = module.initial_states(1)
-    prev_actions = jnp.zeros((1, 1, act_width))
+    carry = jax.device_put(module.initial_states(1), pdev)
+    prev_actions = np.zeros((1, 1, act_width), np.float32)
     while not done:
         device_obs = prepare_obs(obs, cnn_keys, mlp_keys, 1)
         actions, carry = act(params, device_obs, prev_actions, carry)
         np_actions = np.asarray(actions)
         if module.is_continuous:
             env_actions = np_actions.reshape(env.action_space.shape)
-            prev_actions = jnp.asarray(np_actions, jnp.float32).reshape(1, 1, -1)
+            prev_actions = np_actions.astype(np.float32).reshape(1, 1, -1)
         else:
             oh = []
             for i, d in enumerate(module.actions_dim):
                 oh.append(np.eye(d, dtype=np.float32)[np_actions.reshape(1, -1)[:, i]])
-            prev_actions = jnp.asarray(np.concatenate(oh, -1)).reshape(1, 1, -1)
+            prev_actions = np.concatenate(oh, -1).astype(np.float32).reshape(1, 1, -1)
             if np_actions.shape[-1] > 1:
                 env_actions = np_actions.reshape(-1)
             else:

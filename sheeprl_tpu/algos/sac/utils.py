@@ -24,8 +24,9 @@ def flatten_obs(obs: Dict[str, np.ndarray], mlp_keys, num_envs: int) -> np.ndarr
     )
 
 
-def prepare_obs(obs: Dict[str, np.ndarray], mlp_keys, num_envs: int = 1) -> jax.Array:
-    return jnp.asarray(flatten_obs(obs, mlp_keys, num_envs))
+def prepare_obs(obs: Dict[str, np.ndarray], mlp_keys, num_envs: int = 1) -> np.ndarray:
+    # stays numpy: the jitted consumer places it next to its committed params
+    return flatten_obs(obs, mlp_keys, num_envs)
 
 
 def test(actor, actor_params, env, cfg, log_dir: str, logger=None) -> float:
@@ -40,12 +41,16 @@ def test(actor, actor_params, env, cfg, log_dir: str, logger=None) -> float:
         actions, _ = sample_actions(actor, mean, log_std, None, greedy=True)
         return actions
 
+    from ...parallel.placement import place_for_inference
+
+    params_arg = place_for_inference(cfg, actor_params)
+
     done = False
     cumulative_rew = 0.0
     obs, _ = env.reset(seed=cfg.seed)
     while not done:
         o = prepare_obs(obs, mlp_keys, 1)
-        actions = np.asarray(act(actor_params, o)).reshape(env.action_space.shape)
+        actions = np.asarray(act(params_arg, o)).reshape(env.action_space.shape)
         obs, reward, terminated, truncated, _ = env.step(actions)
         done = bool(terminated or truncated)
         cumulative_rew += float(reward)

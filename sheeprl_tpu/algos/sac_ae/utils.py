@@ -60,6 +60,10 @@ def test(encoder, actor, params, env, cfg, log_dir: str, logger=None) -> float:
         actions, _ = sample_actions_features(actor, mean, log_std, None, greedy=True)
         return actions
 
+    from ...parallel.placement import place_for_inference
+
+    params = place_for_inference(cfg, {"encoder": params["encoder"], "actor": params["actor"]})
+
     done = False
     cumulative_rew = 0.0
     obs, _ = env.reset(seed=cfg.seed)

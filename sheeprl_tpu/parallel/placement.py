@@ -119,6 +119,15 @@ class ParamMirror:
                 self.params, self._pending = self._pending, None
         return self.params
 
+
+def place_for_inference(cfg: Any, params: Any) -> Any:
+    """One-shot placement for evaluation rollouts: commit a params subtree to
+    the player device (host CPU when the default backend is a remote
+    accelerator — the same latency story as the training players). Feed the
+    jitted policy NUMPY inputs so every step runs on this device."""
+    return jax.device_put(params, player_device(cfg))
+
+
 def make_param_mirror(cfg: Any, accelerator: Any, params: Any, root_key: Any, allow_async: bool = True):
     """The per-algorithm player setup, in one place: resolve the player
     device, mirror the player's param subtree there, and derive a player PRNG
