@@ -73,6 +73,38 @@ def test_dreamer_v3(standard_args, env_id):
     )
 
 
+def test_dreamer_v2_episode_buffer_memmap(standard_args):
+    """Episode buffer with memmap=True: committed episodes live on disk
+    inside a real training loop (EpisodeBuffer._memmap_episode path)."""
+    args = [a for a in standard_args if not a.startswith("buffer.memmap")]
+    _run(
+        [
+            "exp=dreamer_v2",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "buffer.type=episode",
+            "buffer.memmap=True",
+            "algo.per_rank_batch_size=2",
+            "algo.per_rank_sequence_length=2",
+            "algo.per_rank_pretrain_steps=1",
+            "algo.learning_starts=0",
+            "algo.horizon=4",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=16",
+            "algo.world_model.transition_model.hidden_size=16",
+            "algo.world_model.representation_model.hidden_size=16",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.stochastic_size=4",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.size=64",
+        ],
+        args,
+    )
+
+
 @pytest.mark.parametrize(
     "env_id,buffer_type,distribution",
     [
