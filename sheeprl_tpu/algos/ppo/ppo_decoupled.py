@@ -43,7 +43,7 @@ from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm
 from ...utils.timer import timer
-from ...utils.utils import linear_annealing, save_configs
+from ...utils.utils import WallClockStopper, linear_annealing, save_configs, wall_cap_reached
 from .agent import build_agent
 from .ppo import make_act_fn, make_update_fn, make_value_fn
 from .utils import AGGREGATOR_KEYS, prepare_obs, test
@@ -253,6 +253,19 @@ def main(dist: Distributed, cfg: Config) -> None:
     player.start()
 
     policy_step = 0
+
+    def _ckpt_state():
+        return {
+            "params": params,
+            "opt_state": opt_state,
+            "update": update_iter,
+            "policy_step": policy_step,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng": root_key,
+        }
+
+    wall = WallClockStopper(cfg)
     try:
         for update_iter in range(start_iter, num_updates + 1):
             item = data_q.get()
@@ -317,19 +330,14 @@ def main(dist: Distributed, cfg: Config) -> None:
                 cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
             ) or cfg.dry_run or update_iter == num_updates:
                 last_checkpoint = policy_step
-                ckpt.save(
-                    policy_step,
-                    {
-                        "params": params,
-                        "opt_state": opt_state,
-                        "update": update_iter,
-                        "policy_step": policy_step,
-                        "last_log": last_log,
-                        "last_checkpoint": last_checkpoint,
-                        "rng": root_key,
-                    },
-                )
+                ckpt.save(policy_step, _ckpt_state())
 
+            # wall cap BEFORE releasing the player: it is still parked in
+            # params_q.get(), so the finally-block sentinel lands on an empty
+            # queue and the player exits cleanly (and the shared state the
+            # checkpoint snapshots is quiescent)
+            if wall_cap_reached(wall, policy_step, int(cfg.algo.total_steps), ckpt, _ckpt_state, cfg):
+                break
             params_q.put(params)
     finally:
         # unblock the player whatever happened

@@ -33,7 +33,7 @@ from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm
 from ...utils.timer import timer
-from ...utils.utils import Ratio, save_configs
+from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
 from .agent import build_agent, sample_actions
 from .sac import make_train_fn
 from .utils import AGGREGATOR_KEYS, flatten_obs, test
@@ -232,6 +232,23 @@ def main(dist: Distributed, cfg: Config) -> None:
     policy_step = 0
     rb = None
     ratio_state = None
+
+    def _ckpt_state():
+        s = {
+            "params": params,
+            "opt_states": opt_states,
+            "ratio": ratio_state,
+            "policy_step": policy_step,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "cumulative_grad_steps": cumulative_grad_steps,
+            "rng": root_key,
+        }
+        if cfg.buffer.checkpoint and rb is not None:
+            s["rb"] = rb.checkpoint_state_dict()
+        return s
+
+    wall = WallClockStopper(cfg)
     try:
         while True:
             item = data_q.get()
@@ -278,20 +295,16 @@ def main(dist: Distributed, cfg: Config) -> None:
                 cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
             ) or cfg.dry_run:
                 last_checkpoint = policy_step
-                ckpt_state = {
-                    "params": params,
-                    "opt_states": opt_states,
-                    "ratio": ratio_state,
-                    "policy_step": policy_step,
-                    "last_log": last_log,
-                    "last_checkpoint": last_checkpoint,
-                    "cumulative_grad_steps": cumulative_grad_steps,
-                    "rng": root_key,
-                }
-                if cfg.buffer.checkpoint and rb is not None:
-                    ckpt_state["rb"] = rb.checkpoint_state_dict()
-                ckpt.save(policy_step, ckpt_state)
+                ckpt.save(policy_step, _ckpt_state())
 
+            # wall cap BEFORE releasing the player: it is still parked in
+            # params_q.get(), so the finally-block sentinel lands on an empty
+            # queue and the player exits cleanly; the final save happens in
+            # the save_last tail below, after the player thread has joined
+            if wall_cap_reached(
+                wall, policy_step, int(cfg.algo.total_steps), ckpt, _ckpt_state, cfg, save=False
+            ):
+                break
             params_q.put(params["actor"])
     finally:
         try:
@@ -300,21 +313,10 @@ def main(dist: Distributed, cfg: Config) -> None:
             pass
     player.join(timeout=60)
 
-    # final checkpoint (reference :322-338 on_checkpoint_player save_last)
+    # final checkpoint (reference :322-338 on_checkpoint_player save_last);
+    # runs after player.join, so the buffer snapshot is quiescent
     if cfg.checkpoint.save_last:
-        ckpt_state = {
-            "params": params,
-            "opt_states": opt_states,
-            "ratio": ratio_state,
-            "policy_step": policy_step,
-            "last_log": last_log,
-            "last_checkpoint": last_checkpoint,
-            "cumulative_grad_steps": cumulative_grad_steps,
-            "rng": root_key,
-        }
-        if cfg.buffer.checkpoint and rb is not None:
-            ckpt_state["rb"] = rb.checkpoint_state_dict()
-        ckpt.save(policy_step, ckpt_state)
+        ckpt.save(policy_step, _ckpt_state())
 
     if cfg.algo.run_test:
         test_env = vectorize(

@@ -139,15 +139,19 @@ class WallClockStopper:
         return True
 
 
-def wall_cap_reached(wall: "WallClockStopper", policy_step: int, total_steps: int, ckpt, state_fn, cfg) -> bool:
+def wall_cap_reached(
+    wall: "WallClockStopper", policy_step: int, total_steps: int, ckpt, state_fn, cfg, save: bool = True
+) -> bool:
     """Shared wall-cap stop policy for training loops: when the budget is
     spent, write the final checkpoint (iff `checkpoint.save_last` — the knob
     that means "checkpoint on exit"), record where the run actually stopped
     for in-process callers (utils/run_info.py — the bench computes SPS over
-    the steps that really ran), and tell the caller to break."""
+    the steps that really ran), and tell the caller to break. ``save=False``
+    defers the final checkpoint to a caller-owned exit path (decoupled SAC
+    saves after the player thread has joined)."""
     if not wall.expired(policy_step, total_steps):
         return False
-    if cfg.checkpoint.save_last:
+    if save and cfg.checkpoint.save_last:
         ckpt.save(policy_step, state_fn())
     from . import run_info
 
