@@ -1,0 +1,93 @@
+"""Prefetcher unit tests (data/prefetch.py — the host→HBM streaming piece
+the reference lacks; every Dreamer loop trains through StagedPrefetcher)."""
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import StagedPrefetcher
+from sheeprl_tpu.data.prefetch import DevicePrefetcher
+
+
+def _mk_sampler(counter):
+    def sample(g):
+        counter.append(g)
+        return {"x": np.full((g, 4), float(len(counter)), np.float32)}
+
+    return sample
+
+
+def test_staged_take_returns_staged_batch_without_resampling():
+    calls = []
+    pf = StagedPrefetcher(_mk_sampler(calls))
+    pf.stage(3)
+    assert calls == [3]
+    out = pf.take(3)
+    assert calls == [3]  # no second sample
+    assert out["x"].shape == (3, 4)
+    assert float(np.asarray(out["x"])[0, 0]) == 1.0
+
+
+def test_staged_g_mismatch_falls_back_to_sync_sample():
+    calls = []
+    pf = StagedPrefetcher(_mk_sampler(calls))
+    pf.stage(2)
+    out = pf.take(5)  # Ratio mispredicted → fresh sample with the right g
+    assert calls == [2, 5]
+    assert out["x"].shape == (5, 4)
+    # the stale staged batch must not linger: next take samples again
+    out2 = pf.take(2)
+    assert calls == [2, 5, 2]
+    assert out2["x"].shape == (2, 4)
+
+
+def test_staged_nonpositive_g_clears_staged():
+    calls = []
+    pf = StagedPrefetcher(_mk_sampler(calls))
+    pf.stage(2)
+    pf.stage(0)  # no train burst coming → drop the staged batch
+    assert pf.take(2)["x"].shape == (2, 4)
+    assert calls == [2, 2]  # re-sampled
+
+
+def test_staged_sampler_error_degrades_to_sync():
+    state = {"fail": True}
+
+    def sample(g):
+        if state["fail"]:
+            raise ValueError("buffer not warm yet")
+        return {"x": np.zeros((g, 1), np.float32)}
+
+    pf = StagedPrefetcher(sample)
+    pf.stage(2)  # warmup boundary: sampler raises, nothing staged
+    state["fail"] = False
+    assert pf.take(2)["x"].shape == (2, 1)
+
+
+def test_device_prefetcher_iterates_and_stops():
+    n = [0]
+
+    def sample():
+        n[0] += 1
+        return {"x": np.full((2,), float(n[0]), np.float32)}
+
+    pf = DevicePrefetcher(sample, depth=2).start()
+    first = next(pf)
+    assert np.asarray(first["x"]).shape == (2,)
+    batches = [next(pf) for _ in range(3)]
+    assert all(np.asarray(b["x"]).shape == (2,) for b in batches)
+    pf.stop()
+    assert pf._thread is None
+
+
+def test_device_prefetcher_surfaces_worker_exception():
+    def sample():
+        raise RuntimeError("boom")
+
+    pf = DevicePrefetcher(sample).start()
+    with pytest.raises(RuntimeError, match="boom"):
+        next(pf)
+    pf.stop()
+
+
+def test_device_prefetcher_sync_get():
+    pf = DevicePrefetcher(lambda: {"x": np.ones((3,), np.float32)})
+    assert np.asarray(pf.get()["x"]).shape == (3,)
