@@ -74,8 +74,9 @@ def test_device_prefetcher_iterates_and_stops():
     assert np.asarray(first["x"]).shape == (2,)
     batches = [next(pf) for _ in range(3)]
     assert all(np.asarray(b["x"]).shape == (2,) for b in batches)
+    worker = pf._thread
     pf.stop()
-    assert pf._thread is None
+    assert worker is not None and not worker.is_alive()  # actually terminated
 
 
 def test_device_prefetcher_surfaces_worker_exception():
