@@ -1,0 +1,58 @@
+"""Logger + model-manager unit tests (reference sheeprl/utils/logger.py
+versioned dirs; MlflowModelManager register/version/transition/delete —
+here a file registry, utils/model_manager.py)."""
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.logger import get_log_dir
+from sheeprl_tpu.utils.model_manager import ModelManager
+
+
+def test_log_dir_versions_increment(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    d0 = get_log_dir(None, "algo", "run")
+    d1 = get_log_dir(None, "algo", "run")
+    assert d0.endswith("version_0") and d1.endswith("version_1")
+    # new_version=False reuses the latest existing dir (eval attaching to a run)
+    d_again = get_log_dir(None, "algo", "run", new_version=False)
+    assert d_again == d1
+    # distinct run names version independently
+    other = get_log_dir(None, "algo", "other_run")
+    assert other.endswith("version_0")
+
+
+def test_model_manager_register_version_roundtrip(tmp_path):
+    mm = ModelManager(registry_dir=str(tmp_path / "reg"))
+    params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    v1 = mm.register_model("agent", params, description="first")
+    assert mm.get_latest_version("agent") == 1
+    mm.register_model("agent", {"w": np.zeros((1,), np.float32)}, description="second")
+    assert mm.get_latest_version("agent") == 2
+    # download defaults to the latest; explicit version retrieves the first
+    got_latest = mm.download_model("agent")
+    assert np.asarray(got_latest["w"]).shape == (1,)
+    got_v1 = mm.download_model("agent", version=1)
+    np.testing.assert_allclose(np.asarray(got_v1["w"]), params["w"])
+    assert v1 is not None
+
+
+def test_model_manager_transition_and_delete(tmp_path):
+    mm = ModelManager(registry_dir=str(tmp_path / "reg"))
+    mm.register_model("m", {"w": np.ones((2,), np.float32)})
+    mm.register_model("m", {"w": np.ones((3,), np.float32)})
+    mm.transition_model("m", 1, "production")
+    mm.delete_model("m", version=2)
+    assert mm.get_latest_version("m") == 1
+    assert np.asarray(mm.download_model("m")["w"]).shape == (2,)
+
+
+def test_model_manager_disabled_is_inert(tmp_path):
+    mm = ModelManager(registry_dir=str(tmp_path / "reg"), disabled=True)
+    assert mm.register_model("m", {"w": np.ones((2,))}) is None
+    assert mm.get_latest_version("m") is None
+
+
+def test_model_manager_missing_model_errors(tmp_path):
+    mm = ModelManager(registry_dir=str(tmp_path / "reg"))
+    with pytest.raises((FileNotFoundError, KeyError, ValueError)):
+        mm.download_model("nope")
