@@ -21,7 +21,7 @@ import os
 import sys
 import time
 from functools import partial
-from typing import Any, Dict, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 import gymnasium as gym
 import jax
@@ -79,6 +79,15 @@ def build_optimizers(cfg: Config, params):
         "step": jnp.zeros((), jnp.int32),
     }
     return txs, opt_states
+
+
+def maybe_shard_opt_state(cfg: Config, dist: Optional[Distributed], opt_states):
+    """ZeRO-1-style layout when ``fabric.shard_optimizer_state``: optimizer
+    moments sharded over `dp` (Distributed.shard_over_dp) so the weight
+    update runs 1/N-sharded. Applied to fresh AND resumed state, once."""
+    if dist is not None and cfg.select("fabric.shard_optimizer_state", False):
+        return dist.shard_over_dp(opt_states)
+    return opt_states
 
 
 def make_train_fn(
@@ -432,6 +441,7 @@ def main(dist: Distributed, cfg: Config) -> None:
         moments = state["moments"]
     else:
         moments = init_moments()
+    opt_states = maybe_shard_opt_state(cfg, dist, opt_states)
 
     seq_len = int(cfg.algo.per_rank_sequence_length)
     buffer_size = int(cfg.buffer.size) if not cfg.dry_run else max(4 * seq_len, 64)

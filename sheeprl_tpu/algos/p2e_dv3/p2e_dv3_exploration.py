@@ -551,6 +551,9 @@ def main(dist: Distributed, cfg: Config) -> None:
             "step": jnp.zeros((), jnp.int32),
         }
         moments = {"task": init_moments(), "exploration": {k: init_moments() for k in critic_names}}
+    from ..dreamer_v3.dreamer_v3 import maybe_shard_opt_state
+
+    opt_states = maybe_shard_opt_state(cfg, dist, opt_states)
 
     seq_len = int(cfg.algo.per_rank_sequence_length)
     buffer_size = int(cfg.buffer.size) if not cfg.dry_run else max(4 * seq_len, 64)

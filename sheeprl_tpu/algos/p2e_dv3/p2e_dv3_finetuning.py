@@ -106,6 +106,9 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
     else:
         opt_states = {k: txs[k].init(params[k]) for k in txs}
         opt_states["step"] = jnp.zeros((), jnp.int32)
+    from ..dreamer_v3.dreamer_v3 import maybe_shard_opt_state
+
+    opt_states = maybe_shard_opt_state(cfg, dist, opt_states)
 
     seq_len = int(cfg.algo.per_rank_sequence_length)
     buffer_size = int(cfg.buffer.size) if not cfg.dry_run else max(4 * seq_len, 64)
