@@ -42,6 +42,7 @@ from ...distributions import (
 from ...ops import lambda_values as lambda_values_op
 from ...optim import clipped
 from ...parallel import Distributed
+from ...parallel.mesh import maybe_shard_opt_state
 from ...parallel.placement import make_param_mirror, player_device
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, patch_restarted_envs, vectorize
@@ -79,15 +80,6 @@ def build_optimizers(cfg: Config, params):
         "step": jnp.zeros((), jnp.int32),
     }
     return txs, opt_states
-
-
-def maybe_shard_opt_state(cfg: Config, dist: Optional[Distributed], opt_states):
-    """ZeRO-1-style layout when ``fabric.shard_optimizer_state``: optimizer
-    moments sharded over `dp` (Distributed.shard_over_dp) so the weight
-    update runs 1/N-sharded. Applied to fresh AND resumed state, once."""
-    if dist is not None and cfg.select("fabric.shard_optimizer_state", False):
-        return dist.shard_over_dp(opt_states)
-    return opt_states
 
 
 def make_train_fn(

@@ -43,6 +43,7 @@ from ...distributions import (
 from ...ops import lambda_values as lambda_values_op
 from ...optim import clipped
 from ...parallel import Distributed
+from ...parallel.mesh import maybe_shard_opt_state
 from ...parallel.placement import make_param_mirror, player_device
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, patch_restarted_envs, vectorize
@@ -551,8 +552,6 @@ def main(dist: Distributed, cfg: Config) -> None:
             "step": jnp.zeros((), jnp.int32),
         }
         moments = {"task": init_moments(), "exploration": {k: init_moments() for k in critic_names}}
-    from ..dreamer_v3.dreamer_v3 import maybe_shard_opt_state
-
     opt_states = maybe_shard_opt_state(cfg, dist, opt_states)
 
     seq_len = int(cfg.algo.per_rank_sequence_length)

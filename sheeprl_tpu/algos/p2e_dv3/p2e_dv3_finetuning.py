@@ -19,6 +19,7 @@ from ...config import Config, instantiate
 from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer, StagedPrefetcher
 from ...optim import clipped
 from ...parallel import Distributed
+from ...parallel.mesh import maybe_shard_opt_state
 from ...parallel.placement import make_param_mirror
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, patch_restarted_envs, vectorize
@@ -106,8 +107,6 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
     else:
         opt_states = {k: txs[k].init(params[k]) for k in txs}
         opt_states["step"] = jnp.zeros((), jnp.int32)
-    from ..dreamer_v3.dreamer_v3 import maybe_shard_opt_state
-
     opt_states = maybe_shard_opt_state(cfg, dist, opt_states)
 
     seq_len = int(cfg.algo.per_rank_sequence_length)

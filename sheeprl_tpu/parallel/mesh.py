@@ -22,6 +22,7 @@ current params pytree.
 from __future__ import annotations
 
 import os
+import sys
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
 
@@ -157,8 +158,6 @@ class Distributed:
         (utils/checkpoint.py), which cannot read shards on non-addressable
         devices — on multi-host runs the layout falls back to replicated
         (with a warning) rather than dying at the first checkpoint."""
-        import sys
-
         n = self.world_size
         rep = self.replicated
         if n > 1 and jax.process_count() > 1:
@@ -221,3 +220,12 @@ def build_distributed(cfg: Config) -> Distributed:
         num_nodes=int(fab.get("num_nodes", 1)),
         strategy=fab.get("strategy", "auto"),
     )
+
+
+def maybe_shard_opt_state(cfg: Any, dist: Optional["Distributed"], opt_states: Any) -> Any:
+    """ZeRO-1-style layout when ``fabric.shard_optimizer_state``: optimizer
+    moments sharded over `dp` (Distributed.shard_over_dp) so the weight
+    update runs 1/N-sharded. Applied once, to fresh AND resumed state."""
+    if dist is not None and cfg.select("fabric.shard_optimizer_state", False):
+        return dist.shard_over_dp(opt_states)
+    return opt_states

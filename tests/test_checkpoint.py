@@ -1,0 +1,80 @@
+"""CheckpointManager unit tests (utils/checkpoint.py — the replacement for
+the reference's Fabric-save + CheckpointCallback keep_last pruning,
+callback.py:144-148)."""
+import pickle
+
+import jax
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.checkpoint import CheckpointManager
+
+
+def _state(v=1.0):
+    return {
+        "params": {"w": np.full((3, 3), v, np.float32)},
+        "policy_step": int(v),
+        "rng": jax.random.key(int(v)),
+    }
+
+
+def test_save_load_round_trip_with_prng_key(tmp_path):
+    ckpt = CheckpointManager(str(tmp_path), keep_last=None)
+    path = ckpt.save(10, _state(2.0))
+    assert path and path.endswith("ckpt_10.ckpt")
+    loaded = CheckpointManager.load(path)
+    np.testing.assert_allclose(loaded["params"]["w"], 2.0)
+    # the PRNG key survives as a usable key (not raw uint32 data)
+    k1, k2 = jax.random.split(loaded["rng"])
+    assert k1 is not None and k2 is not None
+    # and reproduces the original stream
+    orig = jax.random.uniform(jax.random.key(2))
+    again = jax.random.uniform(loaded["rng"])
+    np.testing.assert_allclose(np.asarray(orig), np.asarray(again))
+
+
+def test_keep_last_prunes_oldest(tmp_path):
+    ckpt = CheckpointManager(str(tmp_path), keep_last=2)
+    for step in (1, 2, 3, 4):
+        ckpt.save(step, _state(float(step)))
+    names = [p.name for p in ckpt.list_checkpoints()]
+    assert names == ["ckpt_3.ckpt", "ckpt_4.ckpt"]
+
+
+def test_checkpoints_sorted_numerically_not_lexically(tmp_path):
+    ckpt = CheckpointManager(str(tmp_path), keep_last=None)
+    for step in (9, 100, 20):
+        ckpt.save(step, _state())
+    assert [p.name for p in ckpt.list_checkpoints()] == [
+        "ckpt_9.ckpt",
+        "ckpt_20.ckpt",
+        "ckpt_100.ckpt",
+    ]
+
+
+def test_disabled_manager_writes_nothing(tmp_path):
+    ckpt = CheckpointManager(str(tmp_path), enabled=False)
+    assert ckpt.save(1, _state()) is None
+    assert not (tmp_path / "checkpoint").exists()
+
+
+def test_atomic_write_leaves_no_tmp_on_success(tmp_path):
+    ckpt = CheckpointManager(str(tmp_path))
+    ckpt.save(5, _state())
+    leftovers = [p for p in (tmp_path / "checkpoint").iterdir() if p.suffix != ".ckpt"]
+    assert leftovers == []
+
+
+def test_failed_save_does_not_clobber_existing(tmp_path):
+    ckpt = CheckpointManager(str(tmp_path))
+    ckpt.save(7, _state(1.0))
+
+    class _Unpicklable:
+        def __reduce__(self):
+            raise RuntimeError("no pickling")
+
+    with pytest.raises(RuntimeError):
+        ckpt.save(7, {"bad": _Unpicklable()})
+    # the original checkpoint file is intact (atomic tmp+rename)
+    loaded = CheckpointManager.load(tmp_path / "checkpoint" / "ckpt_7.ckpt")
+    np.testing.assert_allclose(loaded["params"]["w"], 1.0)

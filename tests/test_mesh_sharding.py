@@ -31,16 +31,18 @@ def test_sharded_moment_update_matches_replicated():
     values as the replicated layout (the point of ZeRO-1: layout, not math)."""
     dist = _dist()
     grads = jnp.asarray(np.random.default_rng(0).standard_normal((1024, 32)), jnp.float32)
-    mom0 = jnp.zeros((1024, 32))
 
-    @jax.jit
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))  # match the real train step's donation
     def step(m, g):
         m = 0.9 * m + 0.1 * g
         update = m / (jnp.sqrt(jnp.mean(m * m)) + 1e-8)
         return m, update
 
-    m_rep, u_rep = step(jax.device_put(mom0, dist.replicated), grads)
-    sharded0 = dist.shard_over_dp({"m": mom0})["m"]
+    # separate moment arrays per leg: donation consumes the input buffers
+    m_rep, u_rep = step(jax.device_put(jnp.zeros((1024, 32)), dist.replicated), grads)
+    sharded0 = dist.shard_over_dp({"m": jnp.zeros((1024, 32))})["m"]
     assert sharded0.sharding.spec[0] == "dp"
     m_sh, u_sh = step(sharded0, grads)
     np.testing.assert_allclose(np.asarray(u_rep), np.asarray(u_sh), rtol=1e-6)
