@@ -89,3 +89,34 @@ def test_profiler_trace_writes_artifacts():
     import glob as _glob
 
     assert _glob.glob("prof_out/**/*.xplane.pb", recursive=True), "no profiler trace written"
+
+
+def test_eval_round_trip_sac():
+    """Eval round trip for an off-policy algo (the PPO one above covers
+    Template A): train SAC briefly, then evaluate from its checkpoint."""
+    run(
+        [
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.learning_starts=8",
+            "algo.total_steps=32",
+            "algo.run_test=False",
+            "buffer.size=128",
+            "buffer.memmap=False",
+            "metric.log_level=0",
+            "checkpoint.every=16",
+        ]
+    )
+    ckpts = sorted(
+        glob.glob("logs/runs/sac/continuous_dummy/*/version_*/checkpoint/ckpt_*.ckpt"),
+        key=lambda p: (p, int(os.path.basename(p).split("_")[1].split(".")[0])),
+    )
+    assert ckpts, "no SAC checkpoint produced"
+    evaluation([f"checkpoint_path={ckpts[-1]}"])
