@@ -23,6 +23,9 @@ def _capture_main(monkeypatch, records):
     monkeypatch.setattr(bench, "_run_subprocess_record", fake_run)
     monkeypatch.delenv("SHEEPRL_TPU_PROGRESS", raising=False)  # main() setdefaults it
     monkeypatch.setenv("SHEEPRL_TPU_PROGRESS", "0")
+    # main() sets this on the fallback path; registering it with monkeypatch
+    # first means it is restored (removed) on teardown
+    monkeypatch.delenv("BENCH_FORCE_CPU", raising=False)
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench.main()
@@ -62,9 +65,19 @@ def test_error_record_when_everything_fails(monkeypatch):
     assert "error" in rec
 
 
-def test_dead_device_link_fails_fast(monkeypatch):
-    rec, calls = _capture_main(monkeypatch, {})  # preflight returns None
+def test_dead_device_link_falls_back_to_cpu_e2e(monkeypatch):
+    e2e = {"metric": "e2e", "value": 3.0, "unit": "env steps/sec", "vs_baseline": 0.3}
+    rec, calls = _capture_main(monkeypatch, {"dv3": e2e})  # preflight returns None
+    assert REQUIRED <= rec.keys()
+    assert rec["platform"] == "cpu-fallback"
+    assert "preflight" in rec["error"]
+    # the compute-only leg (chip measurement) is skipped on a dead link
+    assert [c[0] for c in calls] == ["preflight", "dv3"]
+
+
+def test_dead_link_and_failed_cpu_fallback_still_prints_json(monkeypatch):
+    rec, calls = _capture_main(monkeypatch, {})  # everything fails
     assert REQUIRED <= rec.keys()
     assert rec["vs_baseline"] == 0.0
-    assert "preflight" in rec["error"]
-    assert [c[0] for c in calls] == ["preflight"]  # expensive legs never ran
+    assert "preflight" in rec["error"]  # the tunnel-down cause survives in the record
+    assert [c[0] for c in calls] == ["preflight", "dv3"]
