@@ -34,6 +34,7 @@ from ...distributions import (
     Normal,
     OneHotCategoricalStraightThrough,
 )
+from ...config.instantiate import locate
 from ...models import MLP, LayerNorm, LayerNormGRUCell
 from ...ops import symlog
 
@@ -290,6 +291,12 @@ class RSSM(nn.Module):
     representation_hidden_size: Optional[int] = None  # defaults to hidden_size
     unimix: float = 0.01
     learnable_initial_recurrent_state: bool = True
+    # DecoupledRSSM (reference agent.py:501-593): the posterior is a function
+    # of the embedded observation ALONE, so the whole [T, B] posterior batch
+    # is one time-parallel MLP application — only the GRU + prior remain in
+    # the scan. TPU-wise this moves most representation FLOPs out of the
+    # sequential chain and onto big MXU-friendly batched matmuls.
+    decoupled: bool = False
 
     def setup(self) -> None:
         self.recurrent_model = RecurrentModel(self.recurrent_state_size, self.dense_units)
@@ -312,7 +319,12 @@ class RSSM(nn.Module):
         return _uniform_mix(logits, self.unimix, self.discrete_size)
 
     def _representation(self, recurrent_state: jax.Array, embedded_obs: jax.Array) -> jax.Array:
-        logits = self.representation_model(jnp.concatenate([recurrent_state, embedded_obs], -1))
+        if self.decoupled:
+            # reference DecoupledRSSM._representation (agent.py:582-593):
+            # posterior from the embedding alone, no recurrent input
+            logits = self.representation_model(embedded_obs)
+        else:
+            logits = self.representation_model(jnp.concatenate([recurrent_state, embedded_obs], -1))
         return _uniform_mix(logits, self.unimix, self.discrete_size)
 
     def initial_states(self, batch_shape: Sequence[int]) -> Tuple[jax.Array, jax.Array]:
@@ -353,6 +365,33 @@ class RSSM(nn.Module):
         logits = self._transition(recurrent_state)
         imagined_prior = compute_stochastic_state(logits, self.discrete_size, key)
         return imagined_prior.reshape(*imagined_prior.shape[:-2], -1), recurrent_state
+
+    def representation_logits(self, embedded_obs: jax.Array) -> jax.Array:
+        """Decoupled posterior logits for a whole [T, B, E] embedding batch at
+        once (reference DecoupledRSSM usage, dreamer_v3.py:115-129, where
+        `_representation` runs over the full sequence before the loop)."""
+        logits = self.representation_model(embedded_obs)
+        return _uniform_mix(logits, self.unimix, self.discrete_size)
+
+    def dynamic_decoupled(
+        self,
+        posterior: jax.Array,  # [B, S*D] flat — PREVIOUS step's precomputed posterior
+        recurrent_state: jax.Array,  # [B, R]
+        action: jax.Array,  # [B, A]
+        is_first: jax.Array,  # [B, 1]
+    ) -> Tuple[jax.Array, jax.Array]:
+        """One decoupled dynamics step (reference DecoupledRSSM.dynamic,
+        agent.py:542-580): only the recurrent state and the prior logits are
+        sequential; the posterior is an input, not an output."""
+        action = (1 - is_first) * action
+        h0, z0 = self.initial_states(recurrent_state.shape[:-1])
+        recurrent_state = (1 - is_first) * recurrent_state + is_first * h0
+        posterior = (1 - is_first) * posterior + is_first * z0
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], -1), recurrent_state
+        )
+        prior_logits = self._transition(recurrent_state)
+        return recurrent_state, prior_logits
 
     def representation_step(
         self, recurrent_state: jax.Array, embedded_obs: jax.Array, key: jax.Array
@@ -407,6 +446,7 @@ class WorldModel(nn.Module):
     unimix: float
     reward_bins: int = 255
     learnable_initial_recurrent_state: bool = True
+    decoupled_rssm: bool = False
     # per-submodule overrides (reference honors each configs/algo key
     # independently: encoder/observation_model/reward/discount dense_units &
     # mlp_layers, recurrent_model.dense_units, representation hidden_size)
@@ -439,6 +479,7 @@ class WorldModel(nn.Module):
             representation_hidden_size=self.representation_hidden_size,
             unimix=self.unimix,
             learnable_initial_recurrent_state=self.learnable_initial_recurrent_state,
+            decoupled=self.decoupled_rssm,
         )
         self.observation_model = DV3Decoder(
             cnn_keys=self.cnn_keys,
@@ -483,6 +524,12 @@ class WorldModel(nn.Module):
 
     def representation_step(self, recurrent_state, embedded_obs, key):
         return self.rssm.representation_step(recurrent_state, embedded_obs, key)
+
+    def representation_logits(self, embedded_obs):
+        return self.rssm.representation_logits(embedded_obs)
+
+    def dynamic_decoupled(self, posterior, recurrent_state, action, is_first):
+        return self.rssm.dynamic_decoupled(posterior, recurrent_state, action, is_first)
 
     def decode(self, latent: jax.Array) -> Dict[str, jax.Array]:
         return self.observation_model(latent)
@@ -543,6 +590,58 @@ class Actor(nn.Module):
         ]
 
 
+# Finite stand-in for the reference's `-inf` logit masking (agent.py:907-924):
+# exp(MASK_LOGIT - lse) underflows to exactly 0.0, so masked actions get zero
+# probability while entropy/log-prob stay NaN-free inside jit.
+MASK_LOGIT = -1e9
+
+
+class MinedojoActor(Actor):
+    """DV3 actor with MineDojo action masking (reference agent.py:848-933).
+
+    Same parameter structure as `Actor` (the forward pass is inherited);
+    masking happens at sampling time in `sample_actor_actions`:
+    * head 0 (action type) is masked by `mask_action_type`;
+    * head 1 (craft/smelt arg) is masked by `mask_craft_smelt` where the
+      sampled action type is 15 (craft);
+    * head 2 (item arg) is masked by `mask_equip_place` where the action type
+      is 16/17 (equip/place) and by `mask_destroy` where it is 18 (destroy).
+    The reference's per-(t, b) python loops (:910-924) become vectorised
+    `jnp.where` updates over the whole batch.
+    """
+
+    masked_heads: bool = True
+
+
+def apply_minedojo_masks(
+    pre_dist: List[jax.Array],
+    mask: Dict[str, jax.Array],
+    functional_action: Optional[jax.Array] = None,
+) -> List[jax.Array]:
+    """Mask each head's (unimixed) logits. `functional_action` is the sampled
+    head-0 action index ([...]-shaped); when None (head 0 not yet sampled)
+    only head 0 is masked — callers re-invoke for heads 1-2 after sampling
+    head 0, mirroring the reference's sequential head loop."""
+    out = list(pre_dist)
+    if "mask_action_type" in mask:
+        m = jnp.broadcast_to(mask["mask_action_type"], out[0].shape)
+        out[0] = jnp.where(m, out[0], MASK_LOGIT)
+    if functional_action is None:
+        return out
+    fa = functional_action[..., None]
+    if len(out) > 1 and "mask_craft_smelt" in mask:
+        m = jnp.broadcast_to(mask["mask_craft_smelt"], out[1].shape)
+        out[1] = jnp.where((fa == 15) & ~m, MASK_LOGIT, out[1])
+    if len(out) > 2:
+        if "mask_equip_place" in mask:
+            m = jnp.broadcast_to(mask["mask_equip_place"], out[2].shape)
+            out[2] = jnp.where(((fa == 16) | (fa == 17)) & ~m, MASK_LOGIT, out[2])
+        if "mask_destroy" in mask:
+            m = jnp.broadcast_to(mask["mask_destroy"], out[2].shape)
+            out[2] = jnp.where((fa == 18) & ~m, MASK_LOGIT, out[2])
+    return out
+
+
 def actor_dists(actor: Actor, pre_dist: List[jax.Array]):
     """Build the per-head distributions from the actor's raw outputs."""
     if actor.is_continuous:
@@ -557,9 +656,29 @@ def actor_dists(actor: Actor, pre_dist: List[jax.Array]):
 
 
 def sample_actor_actions(
-    actor: Actor, pre_dist: List[jax.Array], key: Optional[jax.Array], greedy: bool = False
+    actor: Actor,
+    pre_dist: List[jax.Array],
+    key: Optional[jax.Array],
+    greedy: bool = False,
+    mask: Optional[Dict[str, jax.Array]] = None,
 ) -> Tuple[List[jax.Array], List[Any]]:
-    """Sample (or take the mode of) each action head (reference :788-825)."""
+    """Sample (or take the mode of) each action head (reference :788-825).
+    With a `mask` dict and a masking actor (MinedojoActor), heads are sampled
+    sequentially: head 0's sample gates the masks on heads 1-2 (reference
+    MinedojoActor.forward, agent.py:899-932)."""
+    if mask and getattr(actor, "masked_heads", False) and not actor.is_continuous:
+        mixed = [_uniform_mix(l, actor.unimix, l.shape[-1]) for l in pre_dist]
+        mixed = apply_minedojo_masks(mixed, mask)
+        keys = jax.random.split(key, len(mixed)) if key is not None else [None] * len(mixed)
+        d0 = OneHotCategoricalStraightThrough(logits=mixed[0])
+        a0 = d0.mode if greedy or keys[0] is None else d0.rsample(keys[0])
+        functional_action = jnp.argmax(a0, axis=-1)
+        mixed = apply_minedojo_masks(mixed, mask, functional_action)
+        dists = [OneHotCategoricalStraightThrough(logits=l) for l in mixed]
+        actions = [a0]
+        for d, k in zip(dists[1:], keys[1:]):
+            actions.append(d.mode if greedy or k is None else d.rsample(k))
+        return actions, dists
     dists = actor_dists(actor, pre_dist)
     actions: List[jax.Array] = []
     if actor.is_continuous:
@@ -611,6 +730,7 @@ def build_agent(
         unimix=float(cfg.algo.unimix),
         reward_bins=int(wm_cfg.reward_model.bins),
         learnable_initial_recurrent_state=bool(wm_cfg.learnable_initial_recurrent_state),
+        decoupled_rssm=bool(wm_cfg.select("decoupled_rssm") or False),
         representation_hidden_size=int(wm_cfg.representation_model.hidden_size),
         recurrent_dense_units=int(wm_cfg.recurrent_model.dense_units),
         decoder_cnn_channels_multiplier=int(wm_cfg.observation_model.cnn_channels_multiplier),
@@ -626,7 +746,10 @@ def build_agent(
     latent_size = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size) + int(
         wm_cfg.recurrent_model.recurrent_state_size
     )
-    actor = Actor(
+    # `_target_`-selectable actor class (reference agent.py:1136):
+    # `algo.actor.cls` picks Actor or MinedojoActor
+    actor_cls = locate(str(cfg.algo.actor.select("cls") or f"{__name__}.Actor"))
+    actor = actor_cls(
         actions_dim=tuple(actions_dim),
         is_continuous=is_continuous,
         mlp_layers=int(cfg.algo.actor.mlp_layers),

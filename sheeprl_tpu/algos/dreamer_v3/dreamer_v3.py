@@ -51,11 +51,12 @@ from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...utils.timer import timer
 from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
-from .agent import Actor, WorldModel, build_agent, sample_actor_actions
+from .agent import Actor, WorldModel, build_agent, compute_stochastic_state, sample_actor_actions
 from .loss import reconstruction_loss
 from .utils import (
     AGGREGATOR_KEYS,
     MomentsState,
+    extract_masks,
     init_moments,
     normalize_obs,
     prepare_obs,
@@ -95,6 +96,7 @@ def make_train_fn(
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     wm_cfg = cfg.algo.world_model
     stoch_flat = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
+    decoupled = bool(wm_cfg.select("decoupled_rssm") or False)
     R = int(wm_cfg.recurrent_model.recurrent_state_size)
     horizon = int(cfg.algo.horizon)
     gamma = float(cfg.algo.gamma)
@@ -120,20 +122,45 @@ def make_train_fn(
         def wm_loss_fn(wm_params):
             embedded = wm_apply(wm_params, WorldModel.embed, batch_obs)  # [T, B, E]
 
-            def dyn_step(carry, xs):
-                h, z = carry
-                a, e, first, k = xs
-                h, z, post_logits, prior_logits = wm.apply(
-                    {"params": wm_params}, z, h, a, e, first, k, method=WorldModel.dynamic
-                )
-                return (h, z), (h, z, post_logits, prior_logits)
+            if decoupled:
+                # DecoupledRSSM (reference dreamer_v3.py:115-129): posterior
+                # logits for the WHOLE sequence in one time-parallel MLP —
+                # only h + prior stay sequential. The posterior driving the
+                # recurrent model at step i is the step i-1 sample (zeros at
+                # i=0, reference :118-121).
+                post_logits = wm_apply(wm_params, WorldModel.representation_logits, embedded)
+                zs = compute_stochastic_state(
+                    post_logits, int(wm_cfg.discrete_size), k_dyn
+                ).reshape(T, B, stoch_flat)
+                z_prev = jnp.concatenate([jnp.zeros_like(zs[:1]), zs[:-1]], axis=0)
 
-            keys = jax.random.split(k_dyn, T)
-            h0 = jnp.zeros((B, R))
-            z0 = jnp.zeros((B, stoch_flat))
-            _, (hs, zs, post_logits, prior_logits) = jax.lax.scan(
-                dyn_step, (h0, z0), (batch_actions, embedded, is_first, keys)
-            )
+                def dyn_step_dec(h, xs):
+                    z_in, a, first = xs
+                    h, prior_logits = wm.apply(
+                        {"params": wm_params}, z_in, h, a, first, method=WorldModel.dynamic_decoupled
+                    )
+                    return h, (h, prior_logits)
+
+                h0 = jnp.zeros((B, R))
+                _, (hs, prior_logits) = jax.lax.scan(
+                    dyn_step_dec, h0, (z_prev, batch_actions, is_first)
+                )
+            else:
+
+                def dyn_step(carry, xs):
+                    h, z = carry
+                    a, e, first, k = xs
+                    h, z, post_logits, prior_logits = wm.apply(
+                        {"params": wm_params}, z, h, a, e, first, k, method=WorldModel.dynamic
+                    )
+                    return (h, z), (h, z, post_logits, prior_logits)
+
+                keys = jax.random.split(k_dyn, T)
+                h0 = jnp.zeros((B, R))
+                z0 = jnp.zeros((B, stoch_flat))
+                _, (hs, zs, post_logits, prior_logits) = jax.lax.scan(
+                    dyn_step, (h0, z0), (batch_actions, embedded, is_first, keys)
+                )
             latents = jnp.concatenate([zs, hs], axis=-1)
             recon = wm_apply(wm_params, WorldModel.decode, latents)
             po = {k: MSEDistribution(recon[k], dims=3) for k in cnn_keys}
@@ -364,7 +391,7 @@ def make_player(wm: WorldModel, actor: Actor, cfg: Config, actions_dim, is_conti
         return (jnp.where(m, h0, h), jnp.where(m, z0, z), jnp.where(m, a0, a))
 
     @partial(jax.jit, static_argnames=("greedy",))
-    def step(params, obs, state, key, greedy=False):
+    def step(params, obs, state, key, greedy=False, action_mask=None):
         h, z, a = state
         obs = normalize_obs(obs, cnn_keys)
         embedded = wm.apply({"params": params["wm"]}, obs, method=WorldModel.embed)
@@ -379,7 +406,7 @@ def make_player(wm: WorldModel, actor: Actor, cfg: Config, actions_dim, is_conti
             {"params": params["wm"]}, h, embedded, k1, method=WorldModel.representation_step
         )
         pre = actor.apply({"params": params["actor"]}, jnp.concatenate([z, h], -1))
-        acts, _ = sample_actor_actions(actor, pre, k2, greedy=greedy)
+        acts, _ = sample_actor_actions(actor, pre, k2, greedy=greedy, mask=action_mask)
         a = jnp.concatenate(acts, -1)
         if is_continuous:
             env_actions = a
@@ -539,7 +566,8 @@ def main(dist: Distributed, cfg: Config) -> None:
             else:
                 host_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
                 env_actions, actions_cat, player_state, player_key = player_step_fn(
-                    mirror.current(), host_obs, player_state, player_key
+                    mirror.current(), host_obs, player_state, player_key,
+                    action_mask=extract_masks(obs, num_envs),
                 )
                 actions_np = np.asarray(actions_cat)
                 actions_env = np.asarray(env_actions)
@@ -663,8 +691,8 @@ def main(dist: Distributed, cfg: Config) -> None:
         t_params = jax.device_put({"wm": params["wm"], "actor": params["actor"]}, pdev)
         t_state = t_init(t_params)
 
-        def _step(o, s, k, greedy):
-            env_actions, _, s, k = t_step(t_params, o, s, k, greedy)
+        def _step(o, s, k, greedy, mask=None):
+            env_actions, _, s, k = t_step(t_params, o, s, k, greedy, action_mask=mask)
             return env_actions, s, k
 
         test(_step, t_state, test_env, cfg, log_dir, logger, device=pdev)
@@ -708,8 +736,8 @@ def evaluate_dreamer_v3(dist: Distributed, cfg: Config, state: Dict[str, Any]) -
     t_params = jax.device_put({"wm": params["wm"], "actor": params["actor"]}, pdev)
     t_state = t_init(t_params)
 
-    def _step(o, s, k, greedy):
-        env_actions, _, s, k = t_step(t_params, o, s, k, greedy)
+    def _step(o, s, k, greedy, mask=None):
+        env_actions, _, s, k = t_step(t_params, o, s, k, greedy, action_mask=mask)
         return env_actions, s, k
 
     test(_step, t_state, env, cfg, log_dir, logger, device=pdev)

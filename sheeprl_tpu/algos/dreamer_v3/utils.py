@@ -78,6 +78,17 @@ def normalize_obs(obs: Dict[str, jax.Array], cnn_keys) -> Dict[str, jax.Array]:
     return {k: (v.astype(jnp.float32) / 255.0 - 0.5) if k in cnn_keys else v for k, v in obs.items()}
 
 
+def extract_masks(obs: Dict[str, Any], num_envs: int = 1):
+    """Action-mask obs keys for the (Minedojo)Actor (reference
+    dreamer_v3.py:574-577: every `mask*` obs key gates an actor head).
+    Returns None when the env emits no masks, so non-masking envs never pay
+    a player-step retrace."""
+    masks = {
+        k: np.asarray(v, bool).reshape(num_envs, -1) for k, v in obs.items() if k.startswith("mask")
+    }
+    return masks or None
+
+
 def test(player_step, player_state, env, cfg, log_dir: str, logger=None, seed=None, device=None) -> float:
     """Greedy episode with the recurrent player (reference utils.py test).
     `player_step(obs, state, key, greedy) -> (actions, state, key)` threads
@@ -96,7 +107,9 @@ def test(player_step, player_state, env, cfg, log_dir: str, logger=None, seed=No
     is_box = isinstance(env.action_space, gym.spaces.Box)
     while not done:
         host_obs = prepare_obs(obs, cnn_keys, mlp_keys, 1)
-        env_actions, player_state, key = player_step(host_obs, player_state, key, True)
+        env_actions, player_state, key = player_step(
+            host_obs, player_state, key, True, extract_masks(obs, 1)
+        )
         acts = np.asarray(env_actions)
         if is_box or isinstance(env.action_space, gym.spaces.MultiDiscrete):
             step_action = acts.reshape(env.action_space.shape)

@@ -55,7 +55,8 @@ from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reach
 from ..dreamer_v3.agent import WorldModel, actor_dists, sample_actor_actions
 from ..dreamer_v3.dreamer_v3 import make_player
 from ..dreamer_v3.loss import reconstruction_loss
-from ..dreamer_v3.utils import (
+from ..dreamer_v3.utils import (  # noqa: F401
+    extract_masks,
     init_moments,
     normalize_obs,
     prepare_obs,
@@ -650,7 +651,8 @@ def main(dist: Distributed, cfg: Config) -> None:
             else:
                 host_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
                 env_actions, actions_cat, player_state, player_key = player_step_fn(
-                    mirror.current(), host_obs, player_state, player_key
+                    mirror.current(), host_obs, player_state, player_key,
+                    action_mask=extract_masks(obs, num_envs),
                 )
                 actions_np = np.asarray(actions_cat)
                 actions_env = np.asarray(env_actions)
@@ -769,8 +771,8 @@ def main(dist: Distributed, cfg: Config) -> None:
         t_params = jax.device_put(_player_params(params, "task"), pdev)
         t_state = t_init(t_params)
 
-        def _step(o, s, k, greedy):
-            env_actions, _, s, k = t_step(t_params, o, s, k, greedy)
+        def _step(o, s, k, greedy, mask=None):
+            env_actions, _, s, k = t_step(t_params, o, s, k, greedy, action_mask=mask)
             return env_actions, s, k
 
         test(_step, t_state, test_env, cfg, log_dir, logger, device=pdev)
@@ -833,8 +835,8 @@ def evaluate_p2e_dv3(dist: Distributed, cfg: Config, state: Dict[str, Any]) -> N
     t_params = jax.device_put(params, pdev)
     t_state = t_init(t_params)
 
-    def _step(o, s, k, greedy):
-        env_actions, _, s, k = t_step(t_params, o, s, k, greedy)
+    def _step(o, s, k, greedy, mask=None):
+        env_actions, _, s, k = t_step(t_params, o, s, k, greedy, action_mask=mask)
         return env_actions, s, k
 
     test(_step, t_state, env, cfg, log_dir, logger, device=pdev)

@@ -30,7 +30,13 @@ from ...utils.timer import timer
 from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
 from ..dreamer_v3.agent import build_agent as dv3_build_agent
 from ..dreamer_v3.dreamer_v3 import make_player, make_train_fn
-from ..dreamer_v3.utils import AGGREGATOR_KEYS, init_moments, prepare_obs, test  # noqa: F401
+from ..dreamer_v3.utils import (  # noqa: F401
+    AGGREGATOR_KEYS,
+    extract_masks,
+    init_moments,
+    prepare_obs,
+    test,
+)
 
 MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moments"}
 
@@ -202,7 +208,8 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
                 mirror.refresh(step_params())
             host_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
             env_actions, actions_cat, player_state, player_key = player_step_fn(
-                mirror.current(), host_obs, player_state, player_key
+                mirror.current(), host_obs, player_state, player_key,
+                action_mask=extract_masks(obs, num_envs),
             )
             actions_np = np.asarray(actions_cat)
             actions_env = np.asarray(env_actions)
@@ -311,8 +318,8 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
         t_params = jax.device_put({"wm": params["wm"], "actor": params["actor"]}, pdev)
         t_state = t_init(t_params)
 
-        def _step(o, s, k, greedy):
-            env_actions, _, s, k = t_step(t_params, o, s, k, greedy)
+        def _step(o, s, k, greedy, mask=None):
+            env_actions, _, s, k = t_step(t_params, o, s, k, greedy, action_mask=mask)
             return env_actions, s, k
 
         test(_step, t_state, test_env, cfg, log_dir, logger, device=pdev)

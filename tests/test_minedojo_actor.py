@@ -1,0 +1,101 @@
+"""MinedojoActor masking semantics (reference dreamer_v3/agent.py:848-933):
+head 0 masked by mask_action_type; head 1 (craft arg) masked by
+mask_craft_smelt when the sampled action type is 15; head 2 (item arg)
+masked by mask_equip_place for action types 16/17 and mask_destroy for 18."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    MASK_LOGIT,
+    MinedojoActor,
+    apply_minedojo_masks,
+    sample_actor_actions,
+)
+
+B, A0, A1, A2 = 4, 19, 6, 7
+
+
+def _masks():
+    action_type = np.zeros((B, A0), bool)
+    action_type[:, [0, 15, 16, 18]] = True  # no-op, craft, equip, destroy allowed
+    craft = np.zeros((B, A1), bool)
+    craft[:, 2] = True
+    equip_place = np.zeros((B, A2), bool)
+    equip_place[:, 3] = True
+    destroy = np.zeros((B, A2), bool)
+    destroy[:, 5] = True
+    return {
+        "mask_action_type": jnp.asarray(action_type),
+        "mask_craft_smelt": jnp.asarray(craft),
+        "mask_equip_place": jnp.asarray(equip_place),
+        "mask_destroy": jnp.asarray(destroy),
+    }
+
+
+def test_head0_masking():
+    pre = [jnp.zeros((B, A0)), jnp.zeros((B, A1)), jnp.zeros((B, A2))]
+    out = apply_minedojo_masks(pre, _masks())
+    disallowed = [i for i in range(A0) if i not in (0, 15, 16, 18)]
+    assert np.all(np.asarray(out[0])[:, disallowed] <= MASK_LOGIT)
+    assert np.all(np.asarray(out[0])[:, [0, 15, 16, 18]] == 0.0)
+    # heads 1-2 untouched before the functional action is known
+    assert np.all(np.asarray(out[1]) == 0.0) and np.all(np.asarray(out[2]) == 0.0)
+
+
+@pytest.mark.parametrize(
+    "fa,head,allowed",
+    [
+        (15, 1, [2]),  # craft → mask_craft_smelt on head 1
+        (16, 2, [3]),  # equip → mask_equip_place on head 2
+        (17, 2, [3]),  # place → mask_equip_place on head 2
+        (18, 2, [5]),  # destroy → mask_destroy on head 2
+        (0, 1, list(range(A1))),  # no-op → nothing masked
+    ],
+)
+def test_argument_head_masking(fa, head, allowed):
+    pre = [jnp.zeros((B, A0)), jnp.zeros((B, A1)), jnp.zeros((B, A2))]
+    out = apply_minedojo_masks(pre, _masks(), jnp.full((B,), fa))
+    got = np.asarray(out[head])
+    dim = got.shape[-1]
+    disallowed = [i for i in range(dim) if i not in allowed]
+    if disallowed:
+        assert np.all(got[:, disallowed] <= MASK_LOGIT)
+    assert np.all(got[:, allowed] == 0.0)
+
+
+def test_masked_sampling_respects_masks():
+    actor = MinedojoActor(
+        actions_dim=(A0, A1, A2), is_continuous=False, mlp_layers=1, dense_units=8
+    )
+    latent = jnp.zeros((B, 12))
+    params = actor.init(jax.random.key(0), latent)["params"]
+    pre = actor.apply({"params": params}, latent)
+    masks = _masks()
+    for seed in range(5):
+        acts, dists = sample_actor_actions(actor, pre, jax.random.key(seed), mask=masks)
+        a0 = np.asarray(jnp.argmax(acts[0], -1))
+        assert set(a0.tolist()) <= {0, 15, 16, 18}
+        a1 = np.asarray(jnp.argmax(acts[1], -1))
+        a2 = np.asarray(jnp.argmax(acts[2], -1))
+        for b in range(B):
+            if a0[b] == 15:
+                assert a1[b] == 2
+            if a0[b] in (16, 17):
+                assert a2[b] == 3
+            if a0[b] == 18:
+                assert a2[b] == 5
+        # entropy must stay finite with masked (zero-probability) logits
+        assert all(bool(jnp.isfinite(d.entropy()).all()) for d in dists)
+
+
+def test_unmasked_sampling_unchanged():
+    actor = MinedojoActor(
+        actions_dim=(A0, A1, A2), is_continuous=False, mlp_layers=1, dense_units=8
+    )
+    latent = jnp.zeros((B, 12))
+    params = actor.init(jax.random.key(0), latent)["params"]
+    pre = actor.apply({"params": params}, latent)
+    acts, _ = sample_actor_actions(actor, pre, jax.random.key(1), mask=None)
+    assert len(acts) == 3 and acts[0].shape == (B, A0)
