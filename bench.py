@@ -227,21 +227,27 @@ def main() -> None:
         print(json.dumps(rec))
     else:
         preflight_budget = float(os.environ.get("BENCH_PREFLIGHT_BUDGET_S", 180))
-        pre = _run_subprocess_record(["preflight"], preflight_budget)
-        # a pre-set BENCH_FORCE_CPU also counts: the legs would run on CPU,
-        # so the headline must be labeled accordingly
-        cpu_fallback = pre is None or not pre.get("ok") or bool(os.environ.get("BENCH_FORCE_CPU"))
+        # a pre-set BENCH_FORCE_CPU skips the accelerator probe entirely —
+        # the operator typically sets it BECAUSE the link is dead, and the
+        # probe would just burn the whole preflight budget hanging
+        forced_cpu = bool(os.environ.get("BENCH_FORCE_CPU"))
+        pre = None if forced_cpu else _run_subprocess_record(["preflight"], preflight_budget)
+        preflight_failed = not forced_cpu and (pre is None or not pre.get("ok"))
+        cpu_fallback = preflight_failed or forced_cpu
         os.environ.setdefault("SHEEPRL_TPU_PROGRESS", "1024")  # pacing → stderr
         step_rec = None
         if cpu_fallback:
             # dead accelerator link: measure the e2e recipe on the host CPU
             # backend instead — an honest (clearly labeled) number beats a
             # zero. The compute-only leg is skipped (it measures the chip).
-            print(
-                f"[bench] preflight failed within {preflight_budget}s (tunnel down?); "
-                "falling back to CPU measurement",
-                file=sys.stderr,
-            )
+            if preflight_failed:
+                print(
+                    f"[bench] preflight failed within {preflight_budget}s (tunnel down?); "
+                    "falling back to CPU measurement",
+                    file=sys.stderr,
+                )
+            else:
+                print("[bench] CPU run forced via BENCH_FORCE_CPU", file=sys.stderr)
             os.environ["BENCH_FORCE_CPU"] = "1"
         else:
             print(f"[bench] preflight ok: {pre}", file=sys.stderr)
@@ -252,9 +258,12 @@ def main() -> None:
         e2e_budget = float(os.environ.get("BENCH_E2E_BUDGET_S", 1100))
         e2e_rec = _run_subprocess_record(["dv3"], e2e_budget)
         if e2e_rec is not None and cpu_fallback:
-            e2e_rec["platform"] = "cpu-fallback"
+            e2e_rec["platform"] = "cpu-fallback" if preflight_failed else "cpu-forced"
             e2e_rec["error"] = (
                 "accelerator preflight failed (device client creation hung); "
+                "this is a host-CPU measurement of the same end-to-end recipe"
+                if preflight_failed
+                else "cpu forced via BENCH_FORCE_CPU (preflight not the cause); "
                 "this is a host-CPU measurement of the same end-to-end recipe"
             )
         if e2e_rec is not None:

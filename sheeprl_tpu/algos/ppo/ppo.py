@@ -362,9 +362,11 @@ def main(dist: Distributed, cfg: Config) -> None:
         logger.close()
 
 
-@register_evaluation(algorithms="ppo")
+@register_evaluation(algorithms=["ppo", "ppo_decoupled"])
 def evaluate_ppo(dist: Distributed, cfg: Config, state: Dict[str, Any]) -> None:
-    """Reference ppo/evaluate.py:15: rebuild env+agent from checkpoint, test."""
+    """Reference ppo/evaluate.py:15 and :58: rebuild env+agent from a
+    checkpoint, test. The decoupled trainer saves the same {params} pytree,
+    so one eval covers both entry points."""
     log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
     logger = get_logger(cfg, log_dir, dist.process_index)
     env = vectorize(cfg, cfg.seed, 0, log_dir).envs[0]

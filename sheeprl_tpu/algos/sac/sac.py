@@ -321,8 +321,10 @@ def main(dist: Distributed, cfg: Config) -> None:
         logger.close()
 
 
-@register_evaluation(algorithms="sac")
+@register_evaluation(algorithms=["sac", "sac_decoupled"])
 def evaluate_sac(dist: Distributed, cfg: Config, state: Dict[str, Any]) -> None:
+    """Reference sac/evaluate.py:15 (registered for sac AND sac_decoupled):
+    the decoupled trainer checkpoints the same {params} pytree."""
     log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
     logger = get_logger(cfg, log_dir, dist.process_index)
     env = vectorize(cfg, cfg.seed, 0, log_dir).envs[0]

@@ -55,6 +55,7 @@ def _player_loop(
     world_size: int,
     state,
     seed_key,
+    wall: WallClockStopper,
 ) -> None:
     """Env stepping + buffer ownership (reference player(), :53-338)."""
     try:
@@ -100,6 +101,14 @@ def _player_loop(
         obs_vec = flatten_obs(obs, mlp_keys, num_envs)
 
         while policy_step < total_steps:
+            # the wall cap must hold during warmup too: before learning_starts
+            # the trainer is parked in data_q.get() and its own check never
+            # runs, so an uncapped warmup would overshoot the budget (the
+            # shared stopper makes both sides agree on one clock)
+            if wall_cap_reached(
+                wall, policy_step, total_steps, None, None, cfg, save=False
+            ):
+                break
             with timer("Time/env_interaction_time"):
                 if policy_step <= learning_starts:
                     env_actions = np.stack([action_space.sample() for _ in range(num_envs)])
@@ -218,12 +227,13 @@ def main(dist: Distributed, cfg: Config) -> None:
 
     data_q: "queue.Queue" = queue.Queue(maxsize=1)
     params_q: "queue.Queue" = queue.Queue(maxsize=1)
+    wall = WallClockStopper(cfg)
     player = threading.Thread(
         target=_player_loop,
         name="sac-player",
         args=(
             cfg, actor, params["actor"], log_dir, aggregator, data_q, params_q,
-            batch_size, dist.world_size, state, player_key,
+            batch_size, dist.world_size, state, player_key, wall,
         ),
         daemon=True,
     )
@@ -248,7 +258,6 @@ def main(dist: Distributed, cfg: Config) -> None:
             s["rb"] = rb.checkpoint_state_dict()
         return s
 
-    wall = WallClockStopper(cfg)
     try:
         while True:
             item = data_q.get()

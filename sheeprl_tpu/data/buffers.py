@@ -14,7 +14,9 @@ Layout conventions match the reference: `ReplayBuffer` stores
 """
 from __future__ import annotations
 
+import logging
 import os
+import shutil
 import typing
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -565,10 +567,12 @@ class EpisodeBuffer:
         }
 
     def _drop_episode_dir(self, old: Dict[str, Any]) -> None:
-        """Deterministically release an evicted episode: dropping the last
-        refs unlinks owned memmap files (MemmapArray.__del__); the now-empty
-        per-episode directory is removed too, so long runs don't accumulate
-        unbounded empty dirs."""
+        """Deterministically reclaim an evicted episode's disk space: the
+        whole per-episode directory is removed explicitly (reference
+        buffers.py:1001-1010 shutil.rmtree's evicted episodes) rather than
+        relying on MemmapArray ownership — resumed buffers re-memmap into
+        pre-existing files whose ownership flag is False, and refcount-based
+        unlink would leak them forever."""
         if not self._memmap or self._memmap_dir is None:
             return
         first = next(iter(old.values()), None)
@@ -579,11 +583,13 @@ class EpisodeBuffer:
         )
         old.clear()
         del first
-        if ep_dir is not None:
+        if ep_dir is not None and ep_dir != Path(self._memmap_dir):
             try:
-                ep_dir.rmdir()
-            except OSError:
-                pass
+                shutil.rmtree(ep_dir)
+            except OSError as err:
+                logging.getLogger(__name__).warning(
+                    "could not remove evicted episode dir %s: %s", ep_dir, err
+                )
 
     def sample(
         self,
@@ -660,6 +666,12 @@ class EpisodeBuffer:
             # a memmap buffer stays disk-backed across resume (ReplayBuffer
             # likewise reloads into its memmap storage)
             episodes = [self._memmap_episode({k: np.asarray(v) for k, v in ep.items()}) for ep in episodes]
+            # resuming into an existing memmap dir re-opens pre-resume files
+            # whose existence flips ownership off — reclaim them on eviction
+            for ep in episodes:
+                for arr in ep.values():
+                    if isinstance(arr, MemmapArray):
+                        arr.has_ownership = True
         self._episodes = episodes
         self._open = state["open"]
         self._cum_len = int(state["cum_len"])

@@ -98,8 +98,62 @@ def register_model(cfg: Any, models: Dict[str, Any], log_dir: str) -> None:
         )
 
 
+# checkpoint params-tree keys that differ from the published model names
+_PARAM_KEY_ALIASES = {"world_model": "wm"}
+
+
+def _models_to_register(algo_name: str) -> Optional[Sequence[str]]:
+    """The algo's MODELS_TO_REGISTER contract (reference cli.py:167-181
+    resolves `sheeprl.algos.<algo>.utils.MODELS_TO_REGISTER`): looked up on
+    the registered entrypoint's module first, then its package's utils."""
+    import importlib
+
+    from .registry import get_algorithm
+
+    try:
+        entry = get_algorithm(algo_name)
+    except ValueError:
+        # unknown/external algo: the caller falls back to raw params blobs
+        return None
+    module = importlib.import_module(entry["module"])
+    names = getattr(module, "MODELS_TO_REGISTER", None)
+    if names is None:
+        pkg = entry["module"].rsplit(".", 1)[0]
+        try:
+            names = getattr(importlib.import_module(f"{pkg}.utils"), "MODELS_TO_REGISTER", None)
+        except ModuleNotFoundError:
+            names = None
+    return sorted(names) if names else None
+
+
+def _resolve_model(name: str, state: Dict[str, Any]) -> Any:
+    """Extract one named model from a checkpoint state: 'agent' is the whole
+    params tree; otherwise a key of params (via aliases, e.g. world_model →
+    wm), a top-level state key, or a nested split like moments_task →
+    state['moments']['task']."""
+    params = state.get("params")
+    if name == "agent":
+        return params
+    key = _PARAM_KEY_ALIASES.get(name, name)
+    if isinstance(params, dict) and key in params:
+        return params[key]
+    if key in state:
+        return state[key]
+    if "_" in name:
+        head, rest = name.split("_", 1)
+        node = state.get(head)
+        if isinstance(node, dict) and rest in node:
+            return node[rest]
+        if isinstance(params, dict) and isinstance(params.get(head), dict) and rest in params[head]:
+            return params[head][rest]
+    return None
+
+
 def register_models_from_checkpoint(ckpt_path: pathlib.Path, overrides: Sequence[str]) -> None:
-    """`sheeprl_tpu registration` backend (reference cli.py:408-450)."""
+    """`sheeprl_tpu registration` backend (reference cli.py:408-450): split
+    the checkpoint into the algo's MODELS_TO_REGISTER set and register each
+    as its own versioned model (a DV3 checkpoint yields world_model / actor /
+    critic / target_critic / moments entries, not one params blob)."""
     from .checkpoint import CheckpointManager
     from ..config import load_config_file
 
@@ -107,6 +161,18 @@ def register_models_from_checkpoint(ckpt_path: pathlib.Path, overrides: Sequence
     cfg = load_config_file(cfg_path)
     state = CheckpointManager.load(ckpt_path)
     manager = ModelManager()
-    for key, value in state.items():
-        if key.endswith("params") and value is not None:
-            manager.register_model(f"{cfg.select('algo.name')}_{cfg.select('env.id')}_{key}", value)
+    algo_name = str(cfg.select("algo.name"))
+    prefix = f"{algo_name}_{cfg.select('env.id')}"
+    names = _models_to_register(algo_name)
+    if not names:
+        # unknown contract: fall back to registering raw params blobs
+        for key, value in state.items():
+            if key.endswith("params") and value is not None:
+                manager.register_model(f"{prefix}_{key}", value)
+        return
+    for name in names:
+        value = _resolve_model(name, state)
+        if value is None:
+            print(f"[registration] '{name}' not found in checkpoint {ckpt_path}; skipped")
+            continue
+        manager.register_model(f"{prefix}_{name}", value)

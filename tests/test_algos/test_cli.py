@@ -32,12 +32,14 @@ PPO_ARGS = [
 ]
 
 
-def _latest_ckpt() -> str:
+def _latest_ckpt(pattern: str = "logs/runs/ppo/discrete_dummy/*/version_*/checkpoint/ckpt_*.ckpt") -> str:
+    # newest run dir first, then highest step NUMBER (lexicographic step
+    # sorting would put ckpt_16 before ckpt_8)
     ckpts = sorted(
-        glob.glob("logs/runs/ppo/discrete_dummy/*/version_*/checkpoint/ckpt_*.ckpt"),
-        key=lambda p: (p, int(os.path.basename(p).split("_")[1].split(".")[0])),
+        glob.glob(pattern),
+        key=lambda p: (os.path.dirname(p), int(os.path.basename(p).split("_")[1].split(".")[0])),
     )
-    assert ckpts, "no checkpoint produced"
+    assert ckpts, f"no checkpoint produced for {pattern}"
     return ckpts[-1]
 
 
@@ -91,6 +93,64 @@ def test_profiler_trace_writes_artifacts():
     assert _glob.glob("prof_out/**/*.xplane.pb", recursive=True), "no profiler trace written"
 
 
+def test_eval_round_trip_ppo_decoupled():
+    """`eval` on a decoupled checkpoint (reference ppo/evaluate.py:58: the
+    decoupled entry point shares the coupled eval) — train ppo_decoupled
+    one iteration, then evaluate from its checkpoint."""
+    run(
+        [
+            "exp=ppo_decoupled",
+            "fabric.devices=2",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[]",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.total_steps=16",
+            "algo.run_test=False",
+            "buffer.memmap=False",
+            "metric.log_level=0",
+            "checkpoint.every=8",
+        ]
+    )
+    ckpt = _latest_ckpt("logs/runs/ppo_decoupled/discrete_dummy/*/version_*/checkpoint/ckpt_*.ckpt")
+    evaluation([f"checkpoint_path={ckpt}"])
+
+
+def test_eval_round_trip_sac_decoupled():
+    """Same round trip for sac_decoupled (reference sac/evaluate.py:15
+    registers both sac entry points on one eval)."""
+    run(
+        [
+            "exp=sac_decoupled",
+            "fabric.devices=2",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            "algo.learning_starts=8",
+            "algo.total_steps=32",
+            "algo.run_test=False",
+            "buffer.size=128",
+            "buffer.memmap=False",
+            "metric.log_level=0",
+            "checkpoint.every=16",
+        ]
+    )
+    ckpt = _latest_ckpt("logs/runs/sac_decoupled/continuous_dummy/*/version_*/checkpoint/ckpt_*.ckpt")
+    evaluation([f"checkpoint_path={ckpt}"])
+
+
 def test_eval_round_trip_sac():
     """Eval round trip for an off-policy algo (the PPO one above covers
     Template A): train SAC briefly, then evaluate from its checkpoint."""
@@ -114,9 +174,5 @@ def test_eval_round_trip_sac():
             "checkpoint.every=16",
         ]
     )
-    ckpts = sorted(
-        glob.glob("logs/runs/sac/continuous_dummy/*/version_*/checkpoint/ckpt_*.ckpt"),
-        key=lambda p: (p, int(os.path.basename(p).split("_")[1].split(".")[0])),
-    )
-    assert ckpts, "no SAC checkpoint produced"
-    evaluation([f"checkpoint_path={ckpts[-1]}"])
+    ckpt = _latest_ckpt("logs/runs/sac/continuous_dummy/*/version_*/checkpoint/ckpt_*.ckpt")
+    evaluation([f"checkpoint_path={ckpt}"])

@@ -187,3 +187,29 @@ def test_sample_multi_sample_axis_ordering():
         for b in range(3):
             col = s["observations"][g, :, b, 0]
             assert np.allclose(np.diff(col), 1.0)
+
+
+def test_memmap_eviction_reclaims_disk_after_resume(tmp_path):
+    """Evicted episode dirs are rmtree'd even when the buffer was resumed
+    into a pre-existing memmap dir (where re-opened files lose the
+    MemmapArray ownership flag): reference buffers.py:1001-1010 removes
+    evicted episode dirs unconditionally."""
+    mdir = tmp_path / "eps"
+    rb = EpisodeBuffer(12, n_envs=1, obs_keys=("observations",), memmap=True, memmap_dir=mdir)
+    for _ in range(3):
+        rb.add(_steps(4, 1, done_at=3))
+    state = rb.state_dict()
+
+    # resume into the SAME directory (simulates a restarted process)
+    rb2 = EpisodeBuffer(12, n_envs=1, obs_keys=("observations",), memmap=True, memmap_dir=mdir)
+    rb2.load_state_dict(state)
+    dirs_before = {p for p in mdir.iterdir() if p.is_dir()}
+    assert dirs_before
+    # push enough new episodes to evict every restored one
+    for _ in range(3):
+        rb2.add(_steps(4, 1, done_at=3))
+    remaining = {p for p in mdir.iterdir() if p.is_dir()}
+    # the evicted (restored) episode dirs are gone from disk
+    assert len(remaining) < len(dirs_before | remaining)
+    total_dirs = len(list(mdir.iterdir()))
+    assert total_dirs <= 3, f"stale episode dirs leaked: {sorted(mdir.iterdir())}"

@@ -56,3 +56,73 @@ def test_model_manager_missing_model_errors(tmp_path):
     mm = ModelManager(registry_dir=str(tmp_path / "reg"))
     with pytest.raises((FileNotFoundError, KeyError, ValueError)):
         mm.download_model("nope")
+
+
+def test_models_to_register_contract():
+    """Per-algo MODELS_TO_REGISTER lookup (reference cli.py:167-181)."""
+    import sheeprl_tpu  # noqa: F401 — populates the registry
+    from sheeprl_tpu.utils.model_manager import _models_to_register
+
+    assert _models_to_register("dreamer_v3") == [
+        "actor", "critic", "moments", "target_critic", "world_model",
+    ]
+    assert _models_to_register("ppo") == ["agent"]
+    assert "critics_exploration" in _models_to_register("p2e_dv3_exploration")
+
+
+def test_resolve_model_aliases_and_nesting():
+    from sheeprl_tpu.utils.model_manager import _resolve_model
+
+    state = {
+        "params": {"wm": 1, "actor": 2, "critic": 3, "target_critic": 4},
+        "moments": {"task": 7, "exploration": 8},
+    }
+    assert _resolve_model("world_model", state) == 1
+    assert _resolve_model("actor", state) == 2
+    assert _resolve_model("agent", state) == state["params"]
+    assert _resolve_model("moments_task", state) == 7
+    assert _resolve_model("moments_exploration", state) == 8
+    assert _resolve_model("nonexistent", state) is None
+    assert _resolve_model("moments", {"params": {}, "moments": 5}) == 5
+
+
+def test_registration_splits_dv3_checkpoint(tmp_path, monkeypatch):
+    """A DV3 checkpoint registers world_model/actor/critic/target_critic/
+    moments as SEPARATE versioned models (VERDICT r3 item 7; reference
+    cli.py:167-181 contract) — driven through the real registration backend
+    on a synthetic checkpoint."""
+    import pathlib
+
+    import sheeprl_tpu  # noqa: F401
+    from sheeprl_tpu.config import compose, save_config
+    from sheeprl_tpu.utils.checkpoint import CheckpointManager
+    from sheeprl_tpu.utils.model_manager import register_models_from_checkpoint
+
+    monkeypatch.chdir(tmp_path)
+    log_dir = tmp_path / "run"
+    cfg = compose("config", ["exp=dreamer_v3", "env=dummy", "env.id=discrete_dummy"])
+    log_dir.mkdir()
+    save_config(cfg, str(log_dir / "config.yaml"))
+    cm = CheckpointManager(str(log_dir), keep_last=1, enabled=True)
+    state = {
+        "params": {
+            "wm": {"w": np.ones(2)},
+            "actor": {"w": np.ones(3)},
+            "critic": {"w": np.ones(4)},
+            "target_critic": {"w": np.ones(4)},
+        },
+        "moments": {"low": np.zeros(()), "high": np.zeros(())},
+        "policy_step": 1,
+    }
+    ckpt_path = cm.save(1, state)
+    register_models_from_checkpoint(pathlib.Path(ckpt_path), [])
+    reg = tmp_path / "models_registry"
+    got = sorted(p.name for p in reg.iterdir())
+    expected = [
+        f"dreamer_v3_discrete_dummy_{m}"
+        for m in ("actor", "critic", "moments", "target_critic", "world_model")
+    ]
+    assert got == expected
+    for name in expected:
+        assert (reg / name / "v1" / "params.pkl").exists()
+        assert (reg / name / "v1" / "meta.json").exists()
