@@ -226,12 +226,38 @@ def main() -> None:
             rec = bench_dv3.record()
         print(json.dumps(rec))
     else:
+        # share one persistent XLA compilation cache across all subprocess
+        # legs (and with past runs): a DV3 compile costs tens of seconds on
+        # TPU, and a flaky link means retries — don't re-pay it each time
+        os.environ.setdefault(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache"),
+        )
         preflight_budget = float(os.environ.get("BENCH_PREFLIGHT_BUDGET_S", 180))
+        retries = max(1, int(os.environ.get("BENCH_PREFLIGHT_RETRIES", 3)))
         # a pre-set BENCH_FORCE_CPU skips the accelerator probe entirely —
         # the operator typically sets it BECAUSE the link is dead, and the
         # probe would just burn the whole preflight budget hanging
         forced_cpu = bool(os.environ.get("BENCH_FORCE_CPU"))
-        pre = None if forced_cpu else _run_subprocess_record(["preflight"], preflight_budget)
+        pre = None
+        if not forced_cpu:
+            # the tunnel relay dies and comes back: retry the probe, with all
+            # attempts SHARING the one preflight budget so a hung link costs
+            # no more wall-clock than a single full-budget probe did (the
+            # driver's own timeout is unknown — round 2 died rc=124)
+            attempt_budget = preflight_budget / retries
+            for attempt in range(1, retries + 1):
+                pre = _run_subprocess_record(["preflight"], attempt_budget)
+                if pre is not None and pre.get("ok"):
+                    break
+                if attempt < retries:
+                    pause = float(os.environ.get("BENCH_PREFLIGHT_RETRY_PAUSE_S", 15))
+                    print(
+                        f"[bench] preflight attempt {attempt}/{retries} failed; "
+                        f"retrying in {pause:.0f}s",
+                        file=sys.stderr,
+                    )
+                    time.sleep(pause)
         preflight_failed = not forced_cpu and (pre is None or not pre.get("ok"))
         cpu_fallback = preflight_failed or forced_cpu
         os.environ.setdefault("SHEEPRL_TPU_PROGRESS", "1024")  # pacing → stderr

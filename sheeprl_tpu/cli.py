@@ -163,7 +163,9 @@ def run(args: Optional[Sequence[str]] = None) -> None:
     """`sheeprl_tpu run [exp=... key=value ...]` (reference cli.py:358-366)."""
     argv = list(args if args is not None else sys.argv[1:])
     import sheeprl_tpu  # ensure registries are populated
+    from .utils.utils import enable_compilation_cache
 
+    enable_compilation_cache()
     cfg = compose("config", argv)
     if cfg.select("checkpoint.resume_from"):
         cfg = resume_from_checkpoint(cfg)

@@ -1,5 +1,9 @@
-"""Crafter adapter (reference sheeprl/envs/crafter.py, 67 LoC): Dict 'rgb'
-observation; done splits into terminated (discount 0) vs truncated."""
+"""Crafter adapter (parity target: reference sheeprl/envs/crafter.py).
+
+Behavior contract: Dict `rgb` observation; crafter's single `done` flag is
+split by the `discount` info field — discount 0 means the agent died
+(terminated), anything else is the time-limit (truncated).
+"""
 from __future__ import annotations
 
 from ..utils.imports import _IS_CRAFTER_AVAILABLE
@@ -12,68 +16,41 @@ from typing import Any, Dict, Optional, Tuple, Union
 import crafter
 import gymnasium as gym
 import numpy as np
-from gymnasium import spaces
+
+from .legacy import LegacyEnvAdapter, box_like
+
+_VALID_IDS = ("crafter_reward", "crafter_nonreward")
 
 
-class CrafterWrapper(gym.Env):
-    """Holds the legacy crafter.Env directly — modern gymnasium's Wrapper
-    asserts the core is a gymnasium.Env (see envs/dmc.py note)."""
-
-    def __init__(self, id: str, screen_size: Union[Tuple[int, int], int], seed: Optional[int] = None) -> None:
-        assert id in {"crafter_reward", "crafter_nonreward"}
-        if isinstance(screen_size, int):
-            screen_size = (screen_size,) * 2
-        self.env = crafter.Env(size=screen_size, seed=seed, reward=(id == "crafter_reward"))
-        self.observation_space = spaces.Dict(
-            {
-                "rgb": spaces.Box(
-                    self.env.observation_space.low,
-                    self.env.observation_space.high,
-                    self.env.observation_space.shape,
-                    self.env.observation_space.dtype,
-                )
-            }
-        )
-        self.action_space = spaces.Discrete(self.env.action_space.n)
+class CrafterWrapper(LegacyEnvAdapter):
+    def __init__(
+        self, id: str, screen_size: Union[Tuple[int, int], int], seed: Optional[int] = None
+    ) -> None:
+        if id not in _VALID_IDS:
+            raise AssertionError(f"id must be one of {_VALID_IDS}, got {id!r}")
+        size = (screen_size, screen_size) if isinstance(screen_size, int) else tuple(screen_size)
+        super().__init__(crafter.Env(size=size, seed=seed, reward=id.endswith("_reward")))
+        self.observation_space = box_like(self.env.observation_space)
+        self.action_space = gym.spaces.Discrete(self.env.action_space.n)
         self.reward_range = self.env.reward_range or (-np.inf, np.inf)
-        self.observation_space.seed(seed)
-        self.action_space.seed(seed)
-        self._render_mode = "rgb_array"
+        for sp in (self.observation_space, self.action_space):
+            sp.seed(seed)
         self._metadata = {"render_fps": 30}
 
-    def __getattr__(self, name):
-        if name.startswith("_"):
-            raise AttributeError(name)
-        return getattr(self.env, name)
-
-    @property
-    def render_mode(self) -> Optional[str]:
-        return self._render_mode
-
-    def _convert_obs(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
-        return {"rgb": obs}
-
     def step(self, action: Any):
-        obs, reward, done, info = self.env.step(action)
-        return (
-            self._convert_obs(obs),
-            reward,
-            done and info["discount"] == 0,
-            done and info["discount"] != 0,
-            info,
-        )
+        frame, reward, done, info = self.env.step(action)
+        died = bool(done) and info["discount"] == 0
+        return self._dict_obs(frame), reward, died, bool(done) and not died, info
 
     def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
-        # the reference assigns unconditionally (crafter.py:58), wiping the
-        # constructor seed on every autoreset so all vector envs replay
-        # identical worlds — only override when a seed is actually given
+        # crafter regenerates its world from `_seed` on reset. The reference
+        # overwrites it unconditionally (reference crafter.py:58), which
+        # wipes the constructor seed with None on every autoreset and makes
+        # all vector workers replay the same worlds — only set it when the
+        # caller actually provides one.
         if seed is not None:
             self.env._seed = seed
-        obs = self.env.reset()
-        return self._convert_obs(obs), {}
+        return self._dict_obs(self.env.reset()), {}
 
-    def render(self):
-        return self.env.render()
-
-    def close(self) -> None:
+    def close(self) -> None:  # crafter.Env has no close()
         return

@@ -26,32 +26,32 @@ from gymnasium import spaces
 
 
 def _spec_to_box(spec, dtype) -> spaces.Box:
-    def extract_min_max(s):
-        assert s.dtype == np.float64 or s.dtype == np.float32
-        dim = int(np.prod(s.shape))
-        if type(s) == specs.Array:
-            bound = np.inf * np.ones(dim, dtype=np.float32)
-            return -bound, bound
-        elif type(s) == specs.BoundedArray:
-            zeros = np.zeros(dim, dtype=np.float32)
-            return s.minimum + zeros, s.maximum + zeros
-        raise ValueError(f"Unrecognized spec: {type(s)}")
+    """Flatten a list of dm_env specs into one Box: BoundedArray specs
+    broadcast their bounds over their element count, plain Array specs are
+    unbounded (±inf)."""
 
-    mins, maxs = [], []
-    for s in spec:
-        mn, mx = extract_min_max(s)
-        mins.append(mn)
-        maxs.append(mx)
-    low = np.concatenate(mins, axis=0).astype(dtype)
-    high = np.concatenate(maxs, axis=0).astype(dtype)
-    return spaces.Box(low, high, dtype=dtype)
+    def bounds(s):
+        if s.dtype not in (np.float32, np.float64):
+            raise AssertionError(f"non-float dm_env spec: {s}")
+        n = int(np.prod(s.shape))
+        if isinstance(s, specs.BoundedArray):
+            lo = np.broadcast_to(np.asarray(s.minimum, np.float32), (n,))
+            hi = np.broadcast_to(np.asarray(s.maximum, np.float32), (n,))
+        elif isinstance(s, specs.Array):
+            hi = np.full((n,), np.inf, np.float32)
+            lo = -hi
+        else:
+            raise ValueError(f"Unrecognized spec: {type(s)}")
+        return lo, hi
+
+    lows, highs = (np.concatenate(part).astype(dtype) for part in zip(*map(bounds, spec)))
+    return spaces.Box(lows, highs, dtype=dtype)
 
 
 def _flatten_obs(obs: Dict[Any, Any]) -> np.ndarray:
-    pieces = []
-    for v in obs.values():
-        pieces.append(np.array([v]) if np.isscalar(v) else np.asarray(v).ravel())
-    return np.concatenate(pieces, axis=0)
+    # np.ravel promotes scalars to 1-element arrays, so every value — scalar
+    # reward terms and array sensors alike — concatenates uniformly
+    return np.concatenate([np.ravel(v) for v in obs.values()])
 
 
 class DMCWrapper(gym.Env):

@@ -1,0 +1,72 @@
+"""Shared adapter base for pre-gymnasium environments.
+
+Crafter, nes_py (Super Mario Bros) and dm_control all predate the gymnasium
+API: they return 4-tuple steps, take no `seed=` kwarg on reset, and are not
+`gymnasium.Env` subclasses — so modern gymnasium's `Wrapper` refuses to wrap
+them (it asserts the core's type). The reference wraps them anyway (its
+pinned gym accepted it, e.g. reference sheeprl/envs/crafter.py:17); here the
+legacy env is HELD as a member of a real `gymnasium.Env` instead, and the
+per-suite adapters (envs/crafter.py, envs/super_mario_bros.py) only supply
+the observation dict-ification and the terminated/truncated split their
+suite needs.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import gymnasium as gym
+import numpy as np
+
+
+def box_like(legacy_space, key: str = "rgb") -> gym.spaces.Dict:
+    """A gymnasium Dict({key: Box}) mirroring a legacy Box-like space's
+    low/high/shape/dtype."""
+    return gym.spaces.Dict(
+        {
+            key: gym.spaces.Box(
+                legacy_space.low, legacy_space.high, legacy_space.shape, legacy_space.dtype
+            )
+        }
+    )
+
+
+class LegacyEnvAdapter(gym.Env):
+    """Base for adapters over held (not wrapped) legacy envs.
+
+    Provides attribute delegation to the inner env, the mutable
+    ``render_mode`` property the RecordVideo wrapper expects, and a default
+    passthrough ``render``/``close``. Subclasses set ``self.env`` plus the
+    gymnasium spaces, and implement ``step``/``reset``.
+    """
+
+    obs_key = "rgb"
+
+    def __init__(self, env: Any, render_mode: str = "rgb_array") -> None:
+        self.env = env
+        self._render_mode = render_mode
+
+    def __getattr__(self, name: str):
+        # only public attributes delegate — private lookups failing fast
+        # keeps pickling and gymnasium internals honest
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self.env, name)
+
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    @render_mode.setter
+    def render_mode(self, value: str) -> None:
+        self._render_mode = value
+
+    def _dict_obs(self, frame: np.ndarray) -> Dict[str, np.ndarray]:
+        return {self.obs_key: frame}
+
+    def render(self):
+        return self.env.render()
+
+    def close(self) -> None:
+        closer = getattr(self.env, "close", None)
+        if callable(closer):
+            closer()

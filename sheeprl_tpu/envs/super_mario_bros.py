@@ -1,6 +1,12 @@
-"""Super Mario Bros adapter (reference sheeprl/envs/super_mario_bros.py,
-96 LoC): JoypadSpace action mapping, Dict 'rgb' observation, time-limit done
-reported as truncation."""
+"""Super Mario Bros adapter (parity target: reference
+sheeprl/envs/super_mario_bros.py).
+
+Behavior contract: JoypadSpace discrete action tables (right_only / simple /
+complex); Dict `rgb` observation; nes_py's single `done` is split on the
+game clock (reference super_mario_bros.py:58-59): a done with the clock at
+zero is terminated, a done with time still on the clock is reported as a
+truncation.
+"""
 from __future__ import annotations
 
 from ..utils.imports import _IS_SUPER_MARIO_BROS_AVAILABLE
@@ -10,62 +16,42 @@ if not _IS_SUPER_MARIO_BROS_AVAILABLE:
 
 from typing import Any, Dict, Optional
 
-import gym_super_mario_bros as gsmb
+import gym_super_mario_bros
 import gymnasium as gym
 import numpy as np
-from gym_super_mario_bros.actions import COMPLEX_MOVEMENT, RIGHT_ONLY, SIMPLE_MOVEMENT
+from gym_super_mario_bros import actions as smb_actions
 from nes_py.wrappers import JoypadSpace
 
-ACTIONS_SPACE_MAP = {"simple": SIMPLE_MOVEMENT, "right_only": RIGHT_ONLY, "complex": COMPLEX_MOVEMENT}
+from .legacy import LegacyEnvAdapter, box_like
+
+ACTIONS_SPACE_MAP = {
+    "right_only": smb_actions.RIGHT_ONLY,
+    "simple": smb_actions.SIMPLE_MOVEMENT,
+    "complex": smb_actions.COMPLEX_MOVEMENT,
+}
 
 
-class JoypadSpaceCustomReset(JoypadSpace):
+class _SeedlessJoypad(JoypadSpace):
+    """nes_py's JoypadSpace.reset rejects kwargs; route them to the core."""
+
     def reset(self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
         return self.env.reset(seed=seed, options=options)
 
 
-class SuperMarioBrosWrapper(gym.Env):
-    """Holds the legacy nes_py env directly — modern gymnasium's Wrapper
-    asserts the core is a gymnasium.Env (see envs/dmc.py note)."""
-
+class SuperMarioBrosWrapper(LegacyEnvAdapter):
     def __init__(self, id: str, action_space: str = "simple", render_mode: str = "rgb_array"):
-        env = gsmb.make(id)
-        self.env = env = JoypadSpaceCustomReset(env, ACTIONS_SPACE_MAP[action_space])
-        self._render_mode = render_mode
-        self.observation_space = gym.spaces.Dict(
-            {
-                "rgb": gym.spaces.Box(
-                    env.observation_space.low,
-                    env.observation_space.high,
-                    env.observation_space.shape,
-                    env.observation_space.dtype,
-                )
-            }
-        )
-        self.action_space = gym.spaces.Discrete(env.action_space.n)
-
-    def __getattr__(self, name):
-        if name.startswith("_"):
-            raise AttributeError(name)
-        return getattr(self.env, name)
-
-    @property
-    def render_mode(self) -> str:
-        return self._render_mode
-
-    @render_mode.setter
-    def render_mode(self, render_mode: str):
-        self._render_mode = render_mode
+        joypad = _SeedlessJoypad(gym_super_mario_bros.make(id), ACTIONS_SPACE_MAP[action_space])
+        super().__init__(joypad, render_mode=render_mode)
+        self.observation_space = box_like(joypad.observation_space)
+        self.action_space = gym.spaces.Discrete(joypad.action_space.n)
 
     def step(self, action):
         if isinstance(action, np.ndarray):
             action = action.squeeze().item()
-        obs, reward, done, info = self.env.step(action)
-        # parity with reference super_mario_bros.py:59-60: info["time"] is the
-        # remaining game clock, so any done with time left registers as a
-        # truncation; only timer expiry (time == 0) terminates
-        is_timelimit = info.get("time", False)
-        return {"rgb": obs.copy()}, reward, done and not is_timelimit, done and is_timelimit, info
+        frame, reward, done, info = self.env.step(action)
+        clock_ran_out = not info.get("time", False)
+        terminated = bool(done) and clock_ran_out
+        return self._dict_obs(frame.copy()), reward, terminated, bool(done) and not terminated, info
 
     def render(self):
         frame = self.env.render(mode=self.render_mode)
@@ -74,5 +60,4 @@ class SuperMarioBrosWrapper(gym.Env):
         return None
 
     def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
-        obs = self.env.reset(seed=seed, options=options)
-        return {"rgb": obs.copy()}, {}
+        return self._dict_obs(self.env.reset(seed=seed, options=options).copy()), {}

@@ -22,7 +22,6 @@ current params pytree.
 from __future__ import annotations
 
 import os
-import sys
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
 
@@ -154,19 +153,11 @@ class Distributed:
         all-gather for the parameter delta — the standard DP weight-update
         sharding trade. Gated by ``fabric.shard_optimizer_state``.
 
-        Single-host only for now: checkpointing fetches the state to host
-        (utils/checkpoint.py), which cannot read shards on non-addressable
-        devices — on multi-host runs the layout falls back to replicated
-        (with a warning) rather than dying at the first checkpoint."""
+        Multi-host runs shard too: checkpointing assembles non-addressable
+        shards with a process_allgather collective on every rank
+        (utils/checkpoint.py _fetch_global / CheckpointManager.save)."""
         n = self.world_size
         rep = self.replicated
-        if n > 1 and jax.process_count() > 1:
-            print(
-                "[shard_over_dp] multi-host run: optimizer-state sharding "
-                "falls back to replicated (checkpoint fetch needs addressable shards)",
-                file=sys.stderr,
-            )
-            n = 1
 
         def place(x: Any) -> Any:
             arr = np.asarray(x) if not isinstance(x, jax.Array) else x

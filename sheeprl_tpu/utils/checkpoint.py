@@ -19,12 +19,25 @@ import jax
 import numpy as np
 
 
+def _fetch_global(x: Any) -> np.ndarray:
+    """Fetch an array to host. A multi-host run can hold globally-sharded
+    state (e.g. ZeRO-1 optimizer moments over `dp` spanning hosts) whose
+    shards are NOT all addressable from this process — those are assembled
+    with an all-gather collective (every process must call this, see
+    CheckpointManager.save)."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(x))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def _to_host(tree: Any) -> Any:
     def conv(x: Any) -> Any:
         if isinstance(x, jax.Array):
             if jnp_is_key(x):
                 return {"__prng_key__": np.asarray(jax.random.key_data(x))}
-            return np.asarray(jax.device_get(x))
+            return _fetch_global(x)
         return x
 
     return jax.tree.map(conv, tree)
@@ -57,11 +70,14 @@ class CheckpointManager:
             self.dir.mkdir(parents=True, exist_ok=True)
 
     def save(self, step: int, state: Dict[str, Any]) -> Optional[str]:
+        # host conversion runs on EVERY process, enabled or not: fetching a
+        # globally-sharded array is a collective (all-gather), and a rank-0-
+        # only fetch would deadlock the other hosts (_fetch_global)
+        payload = _to_host(state)
         if not self.enabled:
             return None
         path = self.dir / f"ckpt_{step}.ckpt"
         tmp = path.with_suffix(".tmp")
-        payload = _to_host(state)
         with open(tmp, "wb") as f:
             pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
         os.replace(tmp, path)

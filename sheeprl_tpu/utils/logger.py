@@ -77,8 +77,89 @@ class TensorBoardLogger:
             self._writer.close()
 
 
-def get_logger(cfg: Config, log_dir: str, process_index: int = 0) -> Optional[TensorBoardLogger]:
-    """Rank-0-only logger, honoring metric.log_level (reference logger.py:12-37)."""
+class MLflowLogger:
+    """MLflow tracking logger (reference configs/logger/mlflow.yaml +
+    utils/mlflow.py: remote experiment tracking as an alternative to
+    TensorBoard). Same surface as TensorBoardLogger; requires the `mlflow`
+    package and a tracking URI (`tracking_uri` or $MLFLOW_TRACKING_URI)."""
+
+    def __init__(
+        self,
+        experiment_name: str,
+        run_name: str,
+        tracking_uri: Optional[str] = None,
+        tags: Optional[Dict[str, Any]] = None,
+    ):
+        import mlflow  # gated: raises ModuleNotFoundError when not installed
+
+        self._mlflow = mlflow
+        uri = tracking_uri or os.environ.get("MLFLOW_TRACKING_URI")
+        if uri:
+            mlflow.set_tracking_uri(uri)
+        mlflow.set_experiment(experiment_name)
+        self._run = mlflow.start_run(run_name=run_name)
+        if tags:
+            mlflow.set_tags(dict(tags))
+
+    @property
+    def run_id(self) -> str:
+        return self._run.info.run_id
+
+    def log_metrics(self, metrics: Dict[str, Any], step: int) -> None:
+        clean: Dict[str, float] = {}
+        for name, value in metrics.items():
+            try:
+                clean[name] = float(value)
+            except (TypeError, ValueError):
+                continue
+        if clean:
+            self._mlflow.log_metrics(clean, step=step)
+
+    def log_hyperparams(self, cfg: Dict[str, Any]) -> None:
+        def flatten(node: Any, prefix: str = "") -> Dict[str, Any]:
+            out: Dict[str, Any] = {}
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    out.update(flatten(v, f"{prefix}{k}."))
+            else:
+                out[prefix[:-1]] = node
+            return out
+
+        params = flatten(cfg)
+        keys = sorted(params)
+        for i in range(0, len(keys), 400):  # mlflow caps one batch at 500
+            chunk = {k: params[k] for k in keys[i : i + 400]}
+            try:
+                self._mlflow.log_params(chunk)
+            except Exception as err:
+                import sys
+
+                print(f"[mlflow] log_params chunk failed: {err}", file=sys.stderr)
+
+    def close(self) -> None:
+        self._mlflow.end_run()
+
+
+def _build_logger(cfg: Config, log_dir: str):
+    node = cfg.select("metric.logger", "tensorboard")
+    kind = node if isinstance(node, str) else str(node.get("type", "tensorboard"))
+    if kind == "tensorboard":
+        return TensorBoardLogger(log_dir)
+    if kind == "mlflow":
+        opts = node if isinstance(node, dict) else {}
+        return MLflowLogger(
+            experiment_name=str(opts.get("experiment_name") or cfg.select("root_dir") or "sheeprl_tpu"),
+            run_name=str(opts.get("run_name") or cfg.select("run_name") or "run"),
+            tracking_uri=opts.get("tracking_uri"),
+            tags=opts.get("tags"),
+        )
+    raise ValueError(f"Unknown metric.logger '{kind}' (options: tensorboard, mlflow)")
+
+
+def get_logger(cfg: Config, log_dir: str, process_index: int = 0):
+    """Rank-0-only logger, honoring metric.log_level (reference logger.py:12-37).
+    `metric.logger` selects the backend: `tensorboard` (default) or `mlflow`
+    (select with `logger@metric.logger=mlflow`, reference configs/logger)."""
     if process_index != 0 or cfg.select("metric.log_level", 1) == 0:
         return None
-    return TensorBoardLogger(log_dir)
+    return _build_logger(cfg, log_dir)

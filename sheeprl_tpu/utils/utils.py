@@ -85,6 +85,28 @@ def save_configs(cfg: Any, log_dir: str) -> None:
     save_config(cfg, f"{log_dir}/config.yaml")
 
 
+def enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache: the DreamerV3 train program takes
+    tens of seconds to compile on TPU, and on a flaky-link machine every
+    bench/run attempt would re-pay it. `JAX_COMPILATION_CACHE_DIR` overrides
+    the location (`~/.cache/sheeprl_tpu/xla_cache` by default); set
+    `SHEEPRL_NO_COMPILATION_CACHE=1` to disable. Safe to call repeatedly."""
+    import os
+
+    if os.environ.get("SHEEPRL_NO_COMPILATION_CACHE"):
+        return
+    import jax
+
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.expanduser(
+        "~/.cache/sheeprl_tpu/xla_cache"
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # very old jax: a cold compile beats a crash
+        pass
+
+
 def unwrap_fabric(obj: Any) -> Any:  # parity shim; no wrapping exists here
     return obj
 

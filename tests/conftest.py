@@ -39,3 +39,12 @@ def standard_args():
         "metric.log_level=0",
         "checkpoint.save_last=False",
     ]
+
+
+def pytest_collection_modifyitems(config, items):
+    # `full` implies `slow`: `-m "not slow"` must keep excluding the broad
+    # e2e matrix even though addopts' `-m "not full"` is overridden by any
+    # CLI-provided -m expression
+    for item in items:
+        if "full" in item.keywords:
+            item.add_marker(pytest.mark.slow)

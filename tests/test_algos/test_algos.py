@@ -10,7 +10,14 @@ def _run(args, standard_args):
     run(args + standard_args)
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    [
+        "discrete_dummy",
+        pytest.param("multidiscrete_dummy", marks=pytest.mark.full),
+        pytest.param("continuous_dummy", marks=pytest.mark.full),
+    ],
+)
 def test_ppo(standard_args, env_id):
     _run(
         [
@@ -46,7 +53,14 @@ def test_sac(standard_args):
     )
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    [
+        "discrete_dummy",
+        pytest.param("multidiscrete_dummy", marks=pytest.mark.full),
+        pytest.param("continuous_dummy", marks=pytest.mark.full),
+    ],
+)
 def test_dreamer_v3(standard_args, env_id):
     _run(
         [
@@ -138,12 +152,12 @@ def test_dreamer_v2_episode_buffer_memmap(standard_args):
     "env_id,buffer_type,distribution",
     [
         ("discrete_dummy", "sequential", "auto"),
-        ("discrete_dummy", "episode", "auto"),
-        ("multidiscrete_dummy", "sequential", "auto"),
-        ("multidiscrete_dummy", "episode", "auto"),
-        ("continuous_dummy", "sequential", "auto"),
-        ("continuous_dummy", "episode", "auto"),
-        ("continuous_dummy", "sequential", "tanh_normal"),
+        pytest.param("discrete_dummy", "episode", "auto", marks=pytest.mark.full),
+        pytest.param("multidiscrete_dummy", "sequential", "auto", marks=pytest.mark.full),
+        pytest.param("multidiscrete_dummy", "episode", "auto", marks=pytest.mark.full),
+        pytest.param("continuous_dummy", "sequential", "auto", marks=pytest.mark.full),
+        pytest.param("continuous_dummy", "episode", "auto", marks=pytest.mark.full),
+        pytest.param("continuous_dummy", "sequential", "tanh_normal", marks=pytest.mark.full),
     ],
 )
 def test_dreamer_v2(standard_args, env_id, buffer_type, distribution):
@@ -175,7 +189,14 @@ def test_dreamer_v2(standard_args, env_id, buffer_type, distribution):
     )
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    [
+        "discrete_dummy",
+        pytest.param("multidiscrete_dummy", marks=pytest.mark.full),
+        pytest.param("continuous_dummy", marks=pytest.mark.full),
+    ],
+)
 def test_ppo_recurrent(standard_args, env_id):
     _run(
         [
@@ -197,7 +218,14 @@ def test_ppo_recurrent(standard_args, env_id):
     )
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    [
+        "discrete_dummy",
+        pytest.param("multidiscrete_dummy", marks=pytest.mark.full),
+        pytest.param("continuous_dummy", marks=pytest.mark.full),
+    ],
+)
 def test_dreamer_v1(standard_args, env_id):
     _run(
         [
@@ -223,7 +251,10 @@ def test_dreamer_v1(standard_args, env_id):
     )
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    ["discrete_dummy", pytest.param("continuous_dummy", marks=pytest.mark.full)],
+)
 def test_p2e_dv1(standard_args, env_id, tmp_path):
     """Exploration then finetuning from its checkpoint (reference
     test_algos.py:262-338)."""
@@ -264,7 +295,10 @@ def test_p2e_dv1(standard_args, env_id, tmp_path):
     )
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    ["discrete_dummy", pytest.param("continuous_dummy", marks=pytest.mark.full)],
+)
 def test_p2e_dv3(standard_args, env_id, tmp_path):
     import glob
     import os
@@ -312,7 +346,10 @@ def test_p2e_dv3(standard_args, env_id, tmp_path):
     )
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    ["discrete_dummy", pytest.param("continuous_dummy", marks=pytest.mark.full)],
+)
 def test_p2e_dv2(standard_args, env_id, tmp_path):
     import glob
     import os
@@ -432,7 +469,10 @@ def test_droq(standard_args):
     )
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    ["discrete_dummy", pytest.param("continuous_dummy", marks=pytest.mark.full)],
+)
 def test_a2c(standard_args, env_id):
     _run(
         [

@@ -11,7 +11,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench  # noqa: E402
 
 
-def _capture_main(monkeypatch, records):
+def _capture_main(monkeypatch, records, force_cpu=False):
     """Run bench.main() with _run_subprocess_record stubbed; return parsed
     last stdout line."""
     calls = []
@@ -23,9 +23,12 @@ def _capture_main(monkeypatch, records):
     monkeypatch.setattr(bench, "_run_subprocess_record", fake_run)
     monkeypatch.delenv("SHEEPRL_TPU_PROGRESS", raising=False)  # main() setdefaults it
     monkeypatch.setenv("SHEEPRL_TPU_PROGRESS", "0")
+    monkeypatch.setenv("BENCH_PREFLIGHT_RETRY_PAUSE_S", "0")  # no sleeps in tests
     # main() sets this on the fallback path; registering it with monkeypatch
     # first means it is restored (removed) on teardown
     monkeypatch.delenv("BENCH_FORCE_CPU", raising=False)
+    if force_cpu:
+        monkeypatch.setenv("BENCH_FORCE_CPU", "1")
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench.main()
@@ -71,8 +74,20 @@ def test_dead_device_link_falls_back_to_cpu_e2e(monkeypatch):
     assert REQUIRED <= rec.keys()
     assert rec["platform"] == "cpu-fallback"
     assert "preflight" in rec["error"]
-    # the compute-only leg (chip measurement) is skipped on a dead link
-    assert [c[0] for c in calls] == ["preflight", "dv3"]
+    # the probe retries (flaky relay), then the compute-only leg (a chip
+    # measurement) is skipped on the dead link
+    assert [c[0] for c in calls] == ["preflight"] * 3 + ["dv3"]
+
+
+def test_forced_cpu_skips_preflight_and_labels_record(monkeypatch):
+    """Operator-forced CPU runs (BENCH_FORCE_CPU pre-set) skip the probe of
+    the (typically dead) accelerator entirely and are labeled distinctly
+    from a failed-preflight fallback."""
+    e2e = {"metric": "e2e", "value": 3.0, "unit": "env steps/sec", "vs_baseline": 0.3}
+    rec, calls = _capture_main(monkeypatch, {"dv3": e2e}, force_cpu=True)
+    assert rec["platform"] == "cpu-forced"
+    assert "BENCH_FORCE_CPU" in rec["error"]
+    assert [c[0] for c in calls] == ["dv3"]  # no preflight probe at all
 
 
 def test_dead_link_and_failed_cpu_fallback_still_prints_json(monkeypatch):
@@ -80,4 +95,4 @@ def test_dead_link_and_failed_cpu_fallback_still_prints_json(monkeypatch):
     assert REQUIRED <= rec.keys()
     assert rec["vs_baseline"] == 0.0
     assert "preflight" in rec["error"]  # the tunnel-down cause survives in the record
-    assert [c[0] for c in calls] == ["preflight", "dv3"]
+    assert [c[0] for c in calls] == ["preflight"] * 3 + ["dv3"]

@@ -126,3 +126,49 @@ def test_registration_splits_dv3_checkpoint(tmp_path, monkeypatch):
     for name in expected:
         assert (reg / name / "v1" / "params.pkl").exists()
         assert (reg / name / "v1" / "meta.json").exists()
+
+
+def test_mlflow_logger_with_stub(monkeypatch, tmp_path):
+    """MLflow backend selection (reference configs/logger/mlflow.yaml): the
+    logger drives the mlflow tracking API; stubbed here since the package is
+    not in the image."""
+    import sys
+    import types
+
+    calls = {"metrics": [], "params": [], "ended": 0}
+    stub = types.ModuleType("mlflow")
+    stub.set_tracking_uri = lambda uri: calls.setdefault("uri", uri)
+    stub.set_experiment = lambda name: calls.setdefault("experiment", name)
+    stub.start_run = lambda run_name=None: types.SimpleNamespace(
+        info=types.SimpleNamespace(run_id="r1")
+    )
+    stub.set_tags = lambda tags: calls.setdefault("tags", tags)
+    stub.log_metrics = lambda m, step=None: calls["metrics"].append((m, step))
+    stub.log_params = lambda p: calls["params"].append(p)
+    stub.end_run = lambda: calls.__setitem__("ended", calls["ended"] + 1)
+    monkeypatch.setitem(sys.modules, "mlflow", stub)
+
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.utils.logger import MLflowLogger, get_logger
+
+    cfg = compose("config", ["exp=ppo", "env=dummy", "logger@metric.logger=mlflow"])
+    logger = get_logger(cfg, str(tmp_path))
+    assert isinstance(logger, MLflowLogger) and logger.run_id == "r1"
+    assert calls["experiment"] == "ppo/discrete_dummy"
+    logger.log_metrics({"Loss/x": np.float32(1.5), "bad": object()}, step=7)
+    assert calls["metrics"] == [({"Loss/x": 1.5}, 7)]
+    logger.log_hyperparams({"algo": {"lr": 1e-3}, "seed": 42})
+    assert calls["params"] == [{"algo.lr": 0.001, "seed": 42}]
+    logger.close()
+    assert calls["ended"] == 1
+
+
+def test_unknown_logger_errors():
+    import pytest
+
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.utils.logger import get_logger
+
+    cfg = compose("config", ["exp=ppo", "env=dummy", "metric.logger=nope"])
+    with pytest.raises(ValueError, match="metric.logger"):
+        get_logger(cfg, "/tmp/x")

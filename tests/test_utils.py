@@ -91,7 +91,10 @@ def test_running_metric_empty_returns_none():
     assert RunningMetric("max").compute() is None
 
 
-def test_aggregator_whitelist_and_nan_filtering():
+def test_aggregator_whitelist_and_nan_filtering(monkeypatch):
+    # the class-level kill switch is set by cli.run from metric.log_level, so
+    # a preceding e2e test with log_level=0 would otherwise leak True in here
+    monkeypatch.setattr(MetricAggregator, "disabled", False)
     agg = MetricAggregator({"Loss/a": {"kind": "mean"}, "Loss/b": {"kind": "sum"}})
     agg.update("Loss/a", 2.0)
     agg.update("Loss/a", np.nan)  # NaN aggregate is dropped at compute
