@@ -226,12 +226,13 @@ def main() -> None:
             rec = bench_dv3.record()
         print(json.dumps(rec))
     else:
-        # share one persistent XLA compilation cache across all subprocess
-        # legs (and with past runs): a DV3 compile costs tens of seconds on
-        # TPU, and a flaky link means retries — don't re-pay it each time
+        # share ONE persistent XLA compilation cache across the subprocess
+        # legs, past bench runs AND regular `sheeprl_tpu run` invocations
+        # (same default as utils.enable_compilation_cache): a DV3 compile
+        # costs tens of seconds on TPU and a flaky link means retries
         os.environ.setdefault(
             "JAX_COMPILATION_CACHE_DIR",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache"),
+            os.path.expanduser("~/.cache/sheeprl_tpu/xla_cache"),
         )
         preflight_budget = float(os.environ.get("BENCH_PREFLIGHT_BUDGET_S", 180))
         retries = max(1, int(os.environ.get("BENCH_PREFLIGHT_RETRIES", 3)))
@@ -241,17 +242,23 @@ def main() -> None:
         forced_cpu = bool(os.environ.get("BENCH_FORCE_CPU"))
         pre = None
         if not forced_cpu:
-            # the tunnel relay dies and comes back: retry the probe, with all
-            # attempts SHARING the one preflight budget so a hung link costs
-            # no more wall-clock than a single full-budget probe did (the
-            # driver's own timeout is unknown — round 2 died rc=124)
-            attempt_budget = preflight_budget / retries
+            # the tunnel relay dies and comes back: retry the probe against a
+            # single DEADLINE — attempts and pauses all consume the one
+            # preflight budget, so total wall-clock never exceeds it (the
+            # driver's own timeout is unknown — round 2 died rc=124). A
+            # hung first probe gets the whole window (slow-but-alive links
+            # still pass); retries only happen after FAST failures, which is
+            # exactly the dead-relay connection-refused case.
+            deadline = time.monotonic() + preflight_budget
             for attempt in range(1, retries + 1):
-                pre = _run_subprocess_record(["preflight"], attempt_budget)
+                remaining = deadline - time.monotonic()
+                if remaining <= 1:
+                    break
+                pre = _run_subprocess_record(["preflight"], remaining)
                 if pre is not None and pre.get("ok"):
                     break
-                if attempt < retries:
-                    pause = float(os.environ.get("BENCH_PREFLIGHT_RETRY_PAUSE_S", 15))
+                pause = float(os.environ.get("BENCH_PREFLIGHT_RETRY_PAUSE_S", 15))
+                if attempt < retries and deadline - time.monotonic() > pause:
                     print(
                         f"[bench] preflight attempt {attempt}/{retries} failed; "
                         f"retrying in {pause:.0f}s",

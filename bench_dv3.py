@@ -185,8 +185,12 @@ def record() -> dict:
         rec["model_flops_per_step"] = flops_per_step
         peak = _peak_flops(jax.devices()[0])
         if peak is not None:
-            rec["mfu"] = round(flops_per_step * sps / peak, 4)
+            # flops_per_step and sps are whole-mesh quantities; normalize the
+            # peak by the device count so multi-chip runs report true MFU
+            n_dev = jax.device_count()
+            rec["mfu"] = round(flops_per_step * sps / (peak * n_dev), 4)
             rec["peak_flops_assumed"] = peak
+            rec["devices"] = n_dev
     return rec
 
 

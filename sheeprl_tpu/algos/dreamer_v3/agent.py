@@ -243,17 +243,28 @@ class DV3Decoder(nn.Module):
 
 
 class RecurrentModel(nn.Module):
-    """Dense(no-bias)+LN+SiLU → fused LayerNormGRUCell (reference :281-342)."""
+    """Dense(no-bias)+LN+SiLU → fused LayerNormGRUCell (reference :281-342).
+
+    `features` (the pre-GRU half) is exposed separately: with DecoupledRSSM
+    the GRU inputs are known for the whole sequence up front, so the feature
+    matmul runs time-parallel and only the GRU recurrence stays sequential —
+    optionally as the VMEM-resident Pallas kernel (ops/pallas_gru.py).
+    Attribute names keep the original param-tree layout (mlp / LayerNorm_0 /
+    gru) so existing checkpoints load unchanged."""
 
     recurrent_state_size: int
     dense_units: int
 
-    @nn.compact
+    def setup(self) -> None:
+        self.mlp = nn.Dense(self.dense_units, use_bias=False, kernel_init=xavier_normal)
+        self.LayerNorm_0 = LayerNorm(eps=1e-3)
+        self.gru = LayerNormGRUCell(self.recurrent_state_size, use_bias=False)
+
+    def features(self, x: jax.Array) -> jax.Array:
+        return nn.silu(self.LayerNorm_0(self.mlp(x)))
+
     def __call__(self, x: jax.Array, h: jax.Array) -> jax.Array:
-        feat = nn.Dense(self.dense_units, use_bias=False, kernel_init=xavier_normal, name="mlp")(x)
-        feat = LayerNorm(eps=1e-3)(feat)
-        feat = nn.silu(feat)
-        new_h, _ = LayerNormGRUCell(self.recurrent_state_size, use_bias=False, name="gru")(h, feat)
+        new_h, _ = self.gru(h, self.features(x))
         return new_h
 
 
@@ -365,6 +376,11 @@ class RSSM(nn.Module):
         logits = self._transition(recurrent_state)
         imagined_prior = compute_stochastic_state(logits, self.discrete_size, key)
         return imagined_prior.reshape(*imagined_prior.shape[:-2], -1), recurrent_state
+
+    def recurrent_features(self, z_and_a: jax.Array) -> jax.Array:
+        """Pre-GRU feature half of the recurrent model, time-batched (the
+        Pallas decoupled path, dreamer_v3.py)."""
+        return self.recurrent_model.features(z_and_a)
 
     def representation_logits(self, embedded_obs: jax.Array) -> jax.Array:
         """Decoupled posterior logits for a whole [T, B, E] embedding batch at
@@ -527,6 +543,12 @@ class WorldModel(nn.Module):
 
     def representation_logits(self, embedded_obs):
         return self.rssm.representation_logits(embedded_obs)
+
+    def recurrent_features(self, z_and_a):
+        return self.rssm.recurrent_features(z_and_a)
+
+    def transition_logits(self, recurrent_state):
+        return self.rssm._transition(recurrent_state)
 
     def dynamic_decoupled(self, posterior, recurrent_state, action, is_first):
         return self.rssm.dynamic_decoupled(posterior, recurrent_state, action, is_first)

@@ -40,6 +40,7 @@ from ...distributions import (
     TwoHotEncodingDistribution,
 )
 from ...ops import lambda_values as lambda_values_op
+from ...ops import pallas_gru as pg
 from ...optim import clipped
 from ...parallel import Distributed
 from ...parallel.mesh import maybe_shard_opt_state
@@ -98,6 +99,24 @@ def make_train_fn(
     stoch_flat = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
     decoupled = bool(wm_cfg.select("decoupled_rssm") or False)
     R = int(wm_cfg.recurrent_model.recurrent_state_size)
+    # Pallas scan-resident GRU (ops/pallas_gru.py): only the decoupled path
+    # qualifies (its GRU inputs are time-parallel), only when the fused
+    # weight block fits VMEM; off TPU the kernel runs in interpret mode
+    # (value "interpret" forces that explicitly, e.g. for CI)
+    pallas_mode = wm_cfg.select("pallas_gru") or False
+    use_pallas = (
+        decoupled
+        and bool(pallas_mode)
+        and pg.fits_vmem(int(wm_cfg.recurrent_model.dense_units), R)
+    )
+    if pallas_mode and not use_pallas:
+        print(
+            "[dreamer_v3] algo.world_model.pallas_gru is set but UNUSED: "
+            + ("decoupled_rssm=False" if not decoupled else "weights exceed the VMEM budget")
+            + " — the XLA scan path runs instead",
+            file=sys.stderr,
+        )
+    pallas_interpret = pallas_mode == "interpret" or jax.default_backend() != "tpu"
     horizon = int(cfg.algo.horizon)
     gamma = float(cfg.algo.gamma)
     lmbda = float(cfg.algo.lmbda)
@@ -134,17 +153,46 @@ def make_train_fn(
                 ).reshape(T, B, stoch_flat)
                 z_prev = jnp.concatenate([jnp.zeros_like(zs[:1]), zs[:-1]], axis=0)
 
-                def dyn_step_dec(h, xs):
-                    z_in, a, first = xs
-                    h, prior_logits = wm.apply(
-                        {"params": wm_params}, z_in, h, a, first, method=WorldModel.dynamic_decoupled
+                if use_pallas:
+                    # everything around the recurrence is time-parallel: the
+                    # is_first masking of (z, a), the pre-GRU feature matmul
+                    # and the prior head all batch over T; only the GRU runs
+                    # sequentially — inside the VMEM-resident Pallas kernel
+                    h0_row, z0_row = wm_apply(
+                        wm_params, WorldModel.initial_states, (B,)
                     )
-                    return h, (h, prior_logits)
+                    z_in = (1 - is_first) * z_prev + is_first * z0_row[None]
+                    a_in = (1 - is_first) * batch_actions
+                    feats = wm_apply(
+                        wm_params,
+                        WorldModel.recurrent_features,
+                        jnp.concatenate([z_in, a_in], -1),
+                    )
+                    gru_p = wm_params["rssm"]["recurrent_model"]["gru"]
+                    ln_p = gru_p["LayerNorm_0"]["LayerNorm_0"]
+                    hs = pg.gru_sequence(
+                        feats,
+                        is_first,
+                        h0_row,
+                        gru_p["fused"]["kernel"],
+                        ln_p["scale"],
+                        ln_p["bias"],
+                        pallas_interpret,
+                    )
+                    prior_logits = wm_apply(wm_params, WorldModel.transition_logits, hs)
+                else:
 
-                h0 = jnp.zeros((B, R))
-                _, (hs, prior_logits) = jax.lax.scan(
-                    dyn_step_dec, h0, (z_prev, batch_actions, is_first)
-                )
+                    def dyn_step_dec(h, xs):
+                        z_in, a, first = xs
+                        h, prior_logits = wm.apply(
+                            {"params": wm_params}, z_in, h, a, first, method=WorldModel.dynamic_decoupled
+                        )
+                        return h, (h, prior_logits)
+
+                    h0 = jnp.zeros((B, R))
+                    _, (hs, prior_logits) = jax.lax.scan(
+                        dyn_step_dec, h0, (z_prev, batch_actions, is_first)
+                    )
             else:
 
                 def dyn_step(carry, xs):
