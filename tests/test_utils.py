@@ -161,3 +161,17 @@ def test_mask_velocity_unknown_env_raises():
 
     with pytest.raises(NotImplementedError):
         MaskVelocityWrapper(_NoSpec())
+
+
+def test_every_algorithm_has_an_evaluation():
+    """Parity guarantee of the reference's per-algo evaluate.py files: every
+    registered training entry point must be evaluable from a checkpoint
+    (`eval` on any algo.name resolves; VERDICT r3 item 4 regression)."""
+    import sheeprl_tpu  # noqa: F401 — populates both registries
+    from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry
+
+    missing = sorted(set(algorithm_registry) - set(evaluation_registry))
+    assert not missing, f"algorithms without a registered evaluation: {missing}"
+    assert len(algorithm_registry) >= 17, (
+        f"reference parity needs all 17 entry points; got {sorted(algorithm_registry)}"
+    )
