@@ -43,7 +43,7 @@ from ...ops import lambda_values as lambda_values_op
 from ...ops import pallas_gru as pg
 from ...optim import clipped
 from ...parallel import Distributed
-from ...parallel.mesh import maybe_shard_opt_state
+from ...parallel.mesh import cast_floating, get_precision, maybe_shard_opt_state
 from ...parallel.placement import make_param_mirror, player_device
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, patch_restarted_envs, vectorize
@@ -99,6 +99,12 @@ def make_train_fn(
     stoch_flat = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
     decoupled = bool(wm_cfg.select("decoupled_rssm") or False)
     R = int(wm_cfg.recurrent_model.recurrent_state_size)
+    # mixed precision (reference: Fabric's precision plugin): network
+    # forwards run in the compute dtype (bf16 on the MXU with
+    # fabric.precision=bf16-mixed), master params / losses / Moments stay
+    # f32 — the apply wrappers below are the single cast boundary
+    compute_dtype = get_precision(str(cfg.select("fabric.precision", "32-true"))).compute_dtype
+    mixed = compute_dtype != jnp.float32
     # Pallas scan-resident GRU (ops/pallas_gru.py): only the decoupled path
     # qualifies (its GRU inputs are time-parallel), only when the fused
     # weight block fits VMEM; off TPU the kernel runs in interpret mode
@@ -107,13 +113,20 @@ def make_train_fn(
     use_pallas = (
         decoupled
         and bool(pallas_mode)
+        and not mixed  # the kernel is f32-internal; keep both paths' numerics equal
         and pg.fits_vmem(int(wm_cfg.recurrent_model.dense_units), R)
     )
     if pallas_mode and not use_pallas:
+        reason = (
+            "decoupled_rssm=False"
+            if not decoupled
+            else "mixed precision (the kernel computes in f32)"
+            if mixed
+            else "weights exceed the VMEM budget"
+        )
         print(
-            "[dreamer_v3] algo.world_model.pallas_gru is set but UNUSED: "
-            + ("decoupled_rssm=False" if not decoupled else "weights exceed the VMEM budget")
-            + " — the XLA scan path runs instead",
+            f"[dreamer_v3] algo.world_model.pallas_gru is set but UNUSED: {reason} "
+            "— the XLA scan path runs instead",
             file=sys.stderr,
         )
     pallas_interpret = pallas_mode == "interpret" or jax.default_backend() != "tpu"
@@ -125,8 +138,22 @@ def make_train_fn(
     target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
     moments_cfg = cfg.algo.actor.moments
 
+    def _cast(tree, dtype):
+        return cast_floating(tree, dtype) if mixed else tree
+
     def wm_apply(p, method, *args):
-        return wm.apply({"params": p}, *args, method=method)
+        out = wm.apply({"params": _cast(p, compute_dtype)}, *_cast(args, compute_dtype), method=method)
+        return _cast(out, jnp.float32)
+
+    def actor_apply(p, x):
+        out = actor.apply({"params": _cast(p, compute_dtype)}, _cast(x, compute_dtype))
+        return _cast(out, jnp.float32)
+
+    def critic_apply(p, x):
+        return _cast(
+            critic.apply({"params": _cast(p, compute_dtype)}, _cast(x, compute_dtype)),
+            jnp.float32,
+        )
 
     def one_step(params, opt_states, moments: MomentsState, batch, key):
         T, B = batch["rewards"].shape[:2]
@@ -184,8 +211,8 @@ def make_train_fn(
 
                     def dyn_step_dec(h, xs):
                         z_in, a, first = xs
-                        h, prior_logits = wm.apply(
-                            {"params": wm_params}, z_in, h, a, first, method=WorldModel.dynamic_decoupled
+                        h, prior_logits = wm_apply(
+                            wm_params, WorldModel.dynamic_decoupled, z_in, h, a, first
                         )
                         return h, (h, prior_logits)
 
@@ -198,8 +225,8 @@ def make_train_fn(
                 def dyn_step(carry, xs):
                     h, z = carry
                     a, e, first, k = xs
-                    h, z, post_logits, prior_logits = wm.apply(
-                        {"params": wm_params}, z, h, a, e, first, k, method=WorldModel.dynamic
+                    h, z, post_logits, prior_logits = wm_apply(
+                        wm_params, WorldModel.dynamic, z, h, a, e, first, k
                     )
                     return (h, z), (h, z, post_logits, prior_logits)
 
@@ -259,7 +286,7 @@ def make_train_fn(
 
         def rollout(actor_params, key):
             state0 = jnp.concatenate([imagined_prior0, recurrent0], axis=-1)
-            pre0 = actor.apply({"params": actor_params}, jax.lax.stop_gradient(state0))
+            pre0 = actor_apply(actor_params, jax.lax.stop_gradient(state0))
             k0, key = jax.random.split(key)
             acts0, _ = sample_actor_actions(actor, pre0, k0)
             a0 = jnp.concatenate(acts0, axis=-1)
@@ -267,11 +294,9 @@ def make_train_fn(
             def img_step(carry, k):
                 z, h, a = carry
                 k_img_s, k_a = jax.random.split(k)
-                z, h = wm.apply(
-                    {"params": params["wm"]}, z, h, a, k_img_s, method=WorldModel.imagination
-                )
+                z, h = wm_apply(params["wm"], WorldModel.imagination, z, h, a, k_img_s)
                 state = jnp.concatenate([z, h], axis=-1)
-                pre = actor.apply({"params": actor_params}, jax.lax.stop_gradient(state))
+                pre = actor_apply(actor_params, jax.lax.stop_gradient(state))
                 acts, _ = sample_actor_actions(actor, pre, k_a)
                 a = jnp.concatenate(acts, axis=-1)
                 return (z, h, a), (state, a)
@@ -285,7 +310,7 @@ def make_train_fn(
         def actor_loss_fn(actor_params, moments):
             trajectories, imagined_actions = rollout(actor_params, k_img)
             values = TwoHotEncodingDistribution(
-                critic.apply({"params": params["critic"]}, trajectories), dims=1
+                critic_apply(params["critic"], trajectories), dims=1
             ).mean
             rewards_img = TwoHotEncodingDistribution(
                 wm_apply(params["wm"], WorldModel.reward, trajectories), dims=1
@@ -310,9 +335,7 @@ def make_train_fn(
             normed_lv = (lv - offset) / invscale
             normed_baseline = (baseline - offset) / invscale
             advantage = normed_lv - normed_baseline
-            pre_dist = actor.apply(
-                {"params": actor_params}, jax.lax.stop_gradient(trajectories)
-            )
+            pre_dist = actor_apply(actor_params, jax.lax.stop_gradient(trajectories))
             from .agent import actor_dists
 
             dists = actor_dists(actor, pre_dist)
@@ -350,10 +373,10 @@ def make_train_fn(
 
         def critic_loss_fn(critic_params):
             qv = TwoHotEncodingDistribution(
-                critic.apply({"params": critic_params}, traj_sg[:-1]), dims=1
+                critic_apply(critic_params, traj_sg[:-1]), dims=1
             )
             target_values = TwoHotEncodingDistribution(
-                critic.apply({"params": params["target_critic"]}, traj_sg[:-1]), dims=1
+                critic_apply(params["target_critic"], traj_sg[:-1]), dims=1
             ).mean
             loss = -qv.log_prob(lv_sg) - qv.log_prob(jax.lax.stop_gradient(target_values))
             return jnp.mean(loss * discount[:-1, ..., 0])

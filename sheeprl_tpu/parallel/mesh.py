@@ -33,11 +33,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import Config
 
 _PRECISION_POLICIES = {
-    # name: (param_dtype, compute_dtype)
+    # name: (param_dtype, compute_dtype). No fp16: it would need loss
+    # scaling (the reference pairs Fabric 16-mixed with a GradScaler), and
+    # the MXU's native reduced precision is bf16 anyway.
     "32-true": (jnp.float32, jnp.float32),
     "bf16-mixed": (jnp.float32, jnp.bfloat16),
     "bf16-true": (jnp.bfloat16, jnp.bfloat16),
-    "16-mixed": (jnp.float32, jnp.float16),
 }
 
 
@@ -187,18 +188,21 @@ class Distributed:
 
     # -- dtype policy ------------------------------------------------------
     def cast_compute(self, tree: Any) -> Any:
-        c = self.precision.compute_dtype
-        return jax.tree.map(
-            lambda x: x.astype(c) if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) else x,
-            tree,
-        )
+        return cast_floating(tree, self.precision.compute_dtype)
 
     def cast_params(self, tree: Any) -> Any:
-        p = self.precision.param_dtype
-        return jax.tree.map(
-            lambda x: x.astype(p) if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) else x,
-            tree,
-        )
+        return cast_floating(tree, self.precision.param_dtype)
+
+
+def cast_floating(tree: Any, dtype: Any) -> Any:
+    """Cast every floating leaf of a pytree to `dtype` (PRNG keys, ints and
+    bools pass through) — the single mixed-precision cast primitive."""
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        tree,
+    )
 
 
 def build_distributed(cfg: Config) -> Distributed:

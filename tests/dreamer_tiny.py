@@ -1,0 +1,68 @@
+"""Shared tiny-DreamerV3 harness for burst-level unit tests (pallas parity,
+mixed precision): one place owns the XS override list, the agent/optimizer
+wiring and the synthetic batch."""
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import build_optimizers, make_train_fn
+from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
+from sheeprl_tpu.config import compose
+from sheeprl_tpu.parallel import Distributed
+
+TINY_DV3 = [
+    "exp=dreamer_v3",
+    "algo=dreamer_v3_XS",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "algo.per_rank_batch_size=2",
+    "algo.per_rank_sequence_length=4",
+    "algo.horizon=3",
+    "algo.dense_units=16",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.recurrent_model.dense_units=16",
+    "algo.world_model.transition_model.hidden_size=16",
+    "algo.world_model.representation_model.hidden_size=16",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[]",
+]
+N_ACT = 4
+
+
+def train_burst(overrides, seq_len: int = 4, batch_size: int = 2, seed: int = 7):
+    """Build the tiny agent with TINY_DV3 + overrides and run ONE train
+    burst on a deterministic synthetic batch. Returns (params, opt_states,
+    moments, metrics)."""
+    cfg = compose("config", TINY_DV3 + list(overrides))
+    dist = Distributed(devices=1)
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8)})
+    wm, actor, critic, params = build_agent(
+        dist, cfg, obs_space, [N_ACT], False, jax.random.key(0)
+    )
+    txs, opt_states = build_optimizers(cfg, params)
+    train = make_train_fn(wm, actor, critic, txs, cfg, False, [N_ACT])
+    rng = np.random.default_rng(0)
+    T, B = seq_len, batch_size
+    batch = {
+        "rgb": jnp.asarray(rng.integers(0, 255, (1, T, B, 64, 64, 3), np.uint8)),
+        "actions": jnp.asarray(
+            np.eye(N_ACT, dtype=np.float32)[rng.integers(0, N_ACT, (1, T, B))]
+        ),
+        "rewards": jnp.asarray(rng.standard_normal((1, T, B, 1)), jnp.float32),
+        "terminated": jnp.zeros((1, T, B, 1), jnp.float32),
+        "truncated": jnp.zeros((1, T, B, 1), jnp.float32),
+        "is_first": jnp.zeros((1, T, B, 1), jnp.float32),
+    }
+    return train(
+        params, opt_states, init_moments(), batch, jax.random.split(jax.random.key(seed), 1)
+    )
+
+
+def burst_metrics(overrides, **kw):
+    _, _, _, metrics = train_burst(overrides, **kw)
+    return {k: float(np.asarray(v)) for k, v in metrics.items()}

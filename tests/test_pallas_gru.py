@@ -71,62 +71,10 @@ def test_jit_compiles():
 def test_decoupled_train_paths_agree():
     """The Pallas-GRU decoupled world-model dynamics must match the scan
     path bit-for-bit-ish: same params, same batch, same keys → same losses."""
-    import gymnasium as gym
+    from dreamer_tiny import burst_metrics
 
-    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
-    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import build_optimizers, make_train_fn
-    from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
-    from sheeprl_tpu.config import compose
-    from sheeprl_tpu.parallel import Distributed
-
-    tiny = [
-        "exp=dreamer_v3",
-        "algo=dreamer_v3_XS",
-        "env=dummy",
-        "env.id=discrete_dummy",
-        "algo.world_model.decoupled_rssm=True",
-        "algo.per_rank_batch_size=2",
-        "algo.per_rank_sequence_length=4",
-        "algo.horizon=3",
-        "algo.dense_units=16",
-        "algo.world_model.encoder.cnn_channels_multiplier=2",
-        "algo.world_model.recurrent_model.recurrent_state_size=8",
-        "algo.world_model.recurrent_model.dense_units=16",
-        "algo.world_model.transition_model.hidden_size=16",
-        "algo.world_model.representation_model.hidden_size=16",
-        "algo.world_model.discrete_size=4",
-        "algo.world_model.stochastic_size=4",
-        "algo.cnn_keys.encoder=[rgb]",
-        "algo.mlp_keys.encoder=[]",
-    ]
-    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8)})
-
-    def one_burst(pallas: bool):
-        cfg = compose(
-            "config", tiny + ([f"algo.world_model.pallas_gru=interpret"] if pallas else [])
-        )
-        dist = Distributed(devices=1)
-        wm, actor, critic, params = build_agent(
-            dist, cfg, obs_space, [4], False, jax.random.key(0)
-        )
-        txs, opt_states = build_optimizers(cfg, params)
-        train = make_train_fn(wm, actor, critic, txs, cfg, False, [4])
-        rng = np.random.default_rng(0)
-        Tn, Bn = 4, 2
-        batch = {
-            "rgb": jnp.asarray(rng.integers(0, 255, (1, Tn, Bn, 64, 64, 3), np.uint8)),
-            "actions": jnp.asarray(np.eye(4, dtype=np.float32)[rng.integers(0, 4, (1, Tn, Bn))]),
-            "rewards": jnp.asarray(rng.standard_normal((1, Tn, Bn, 1)), jnp.float32),
-            "terminated": jnp.zeros((1, Tn, Bn, 1), jnp.float32),
-            "truncated": jnp.zeros((1, Tn, Bn, 1), jnp.float32),
-            "is_first": jnp.zeros((1, Tn, Bn, 1), jnp.float32),
-        }
-        _, _, _, metrics = train(
-            params, opt_states, init_moments(), batch, jax.random.split(jax.random.key(7), 1)
-        )
-        return {k: float(np.asarray(v)) for k, v in metrics.items()}
-
-    ref = one_burst(pallas=False)
-    pal = one_burst(pallas=True)
+    base = ["algo.world_model.decoupled_rssm=True"]
+    ref = burst_metrics(base)
+    pal = burst_metrics(base + ["algo.world_model.pallas_gru=interpret"])
     for k in ("Loss/world_model_loss", "State/kl", "Loss/reward_loss"):
         assert ref[k] == pytest.approx(pal[k], rel=1e-4), (k, ref[k], pal[k])
