@@ -43,7 +43,7 @@ from ...ops import lambda_values as lambda_values_op
 from ...ops import pallas_gru as pg
 from ...optim import clipped
 from ...parallel import Distributed
-from ...parallel.mesh import cast_floating, get_precision, maybe_shard_opt_state
+from ...parallel.mesh import maybe_shard_opt_state
 from ...parallel.placement import make_param_mirror, player_device
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, patch_restarted_envs, vectorize
@@ -59,6 +59,7 @@ from .utils import (
     MomentsState,
     extract_masks,
     init_moments,
+    make_precision_applies,
     normalize_obs,
     prepare_obs,
     test,
@@ -99,12 +100,11 @@ def make_train_fn(
     stoch_flat = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
     decoupled = bool(wm_cfg.select("decoupled_rssm") or False)
     R = int(wm_cfg.recurrent_model.recurrent_state_size)
-    # mixed precision (reference: Fabric's precision plugin): network
-    # forwards run in the compute dtype (bf16 on the MXU with
-    # fabric.precision=bf16-mixed), master params / losses / Moments stay
-    # f32 — the apply wrappers below are the single cast boundary
-    compute_dtype = get_precision(str(cfg.select("fabric.precision", "32-true"))).compute_dtype
-    mixed = compute_dtype != jnp.float32
+    # mixed precision (reference: Fabric's precision plugin) — shared cast
+    # boundary, utils.make_precision_applies
+    wm_apply, actor_apply, critic_apply, _cast, compute_dtype, mixed = make_precision_applies(
+        cfg, wm, actor, critic
+    )
     # Pallas scan-resident GRU (ops/pallas_gru.py): only the decoupled path
     # qualifies (its GRU inputs are time-parallel), only when the fused
     # weight block fits VMEM; off TPU the kernel runs in interpret mode
@@ -138,22 +138,6 @@ def make_train_fn(
     target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
     moments_cfg = cfg.algo.actor.moments
 
-    def _cast(tree, dtype):
-        return cast_floating(tree, dtype) if mixed else tree
-
-    def wm_apply(p, method, *args):
-        out = wm.apply({"params": _cast(p, compute_dtype)}, *_cast(args, compute_dtype), method=method)
-        return _cast(out, jnp.float32)
-
-    def actor_apply(p, x):
-        out = actor.apply({"params": _cast(p, compute_dtype)}, _cast(x, compute_dtype))
-        return _cast(out, jnp.float32)
-
-    def critic_apply(p, x):
-        return _cast(
-            critic.apply({"params": _cast(p, compute_dtype)}, _cast(x, compute_dtype)),
-            jnp.float32,
-        )
 
     def one_step(params, opt_states, moments: MomentsState, batch, key):
         T, B = batch["rewards"].shape[:2]

@@ -78,6 +78,35 @@ def normalize_obs(obs: Dict[str, jax.Array], cnn_keys) -> Dict[str, jax.Array]:
     return {k: (v.astype(jnp.float32) / 255.0 - 0.5) if k in cnn_keys else v for k, v in obs.items()}
 
 
+def make_precision_applies(cfg: Any, wm, actor, critic):
+    """The single mixed-precision cast boundary shared by the DV3-family
+    train steps (dreamer_v3 / p2e_dv3): network forwards run in
+    `fabric.precision`'s compute dtype, inputs/outputs cross in f32 so
+    losses, Moments and master params stay full precision. Returns
+    (wm_apply, actor_apply, critic_apply, cast, compute_dtype, mixed)."""
+    import jax.numpy as jnp
+
+    from ...parallel.mesh import cast_floating, get_precision
+
+    compute_dtype = get_precision(str(cfg.select("fabric.precision", "32-true"))).compute_dtype
+    mixed = compute_dtype != jnp.float32
+
+    def cast(tree, dtype):
+        return cast_floating(tree, dtype) if mixed else tree
+
+    def wm_apply(p, method, *args):
+        out = wm.apply({"params": cast(p, compute_dtype)}, *cast(args, compute_dtype), method=method)
+        return cast(out, jnp.float32)
+
+    def actor_apply(p, x):
+        return cast(actor.apply({"params": cast(p, compute_dtype)}, cast(x, compute_dtype)), jnp.float32)
+
+    def critic_apply(p, x):
+        return cast(critic.apply({"params": cast(p, compute_dtype)}, cast(x, compute_dtype)), jnp.float32)
+
+    return wm_apply, actor_apply, critic_apply, cast, compute_dtype, mixed
+
+
 def extract_masks(obs: Dict[str, Any], num_envs: int = 1):
     """Action-mask obs keys for the (Minedojo)Actor (reference
     dreamer_v3.py:574-577: every `mask*` obs key gates an actor head).

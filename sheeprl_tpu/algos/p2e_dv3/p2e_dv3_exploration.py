@@ -57,6 +57,7 @@ from ..dreamer_v3.dreamer_v3 import make_player
 from ..dreamer_v3.loss import reconstruction_loss
 from ..dreamer_v3.utils import (  # noqa: F401
     extract_masks,
+    make_precision_applies,
     init_moments,
     normalize_obs,
     prepare_obs,
@@ -123,8 +124,13 @@ def make_train_fn(
     }
     weights_sum = sum(c["weight"] for c in critics_cfg.values())
 
-    def wm_apply(p, method, *args):
-        return wm.apply({"params": p}, *args, method=method)
+    # mixed precision: shared cast boundary (dreamer_v3/utils.py)
+    wm_apply, actor_apply, critic_apply, _cast, compute_dtype, mixed = make_precision_applies(
+        cfg, wm, actor, critic
+    )
+
+    def ens_apply_c(p, x):
+        return _cast(ens_apply(_cast(p, compute_dtype), _cast(x, compute_dtype)), jnp.float32)
 
     def moments_step(moments, lv):
         return update_moments(
@@ -152,8 +158,8 @@ def make_train_fn(
             def dyn_step(carry, xs):
                 h, z = carry
                 a, e, first, k = xs
-                h, z, post_logits, prior_logits = wm.apply(
-                    {"params": wm_params}, z, h, a, e, first, k, method=WorldModel.dynamic
+                h, z, post_logits, prior_logits = wm_apply(
+                    wm_params, WorldModel.dynamic, z, h, a, e, first, k
                 )
                 return (h, z), (h, z, post_logits, prior_logits)
 
@@ -218,7 +224,7 @@ def make_train_fn(
         # ---------------- 2. ensembles ------------------------------------
         def ens_loss_fn(ens_params):
             inp = jnp.concatenate([zs, hs, batch["actions"]], axis=-1)
-            out = ens_apply(ens_params, inp)[:, :-1]  # [n, T-1, B, Z]
+            out = ens_apply_c(ens_params, inp)[:, :-1]  # [n, T-1, B, Z]
             dist = MSEDistribution(out, dims=1)
             return -jnp.sum(jnp.mean(dist.log_prob(zs[None, 1:]), axis=(1, 2)))
 
@@ -235,7 +241,7 @@ def make_train_fn(
         def rollout(actor_params, key):
             """DV3-style imagination: trajectories/actions have H+1 rows."""
             state0 = jnp.concatenate([imagined_prior0, recurrent0], axis=-1)
-            pre0 = actor.apply({"params": actor_params}, jax.lax.stop_gradient(state0))
+            pre0 = actor_apply(actor_params, jax.lax.stop_gradient(state0))
             k0, key = jax.random.split(key)
             acts0, _ = sample_actor_actions(actor, pre0, k0)
             a0 = jnp.concatenate(acts0, axis=-1)
@@ -243,11 +249,9 @@ def make_train_fn(
             def img_step(carry, k):
                 z, h, a = carry
                 k_img_s, k_a = jax.random.split(k)
-                z, h = wm.apply(
-                    {"params": params["wm"]}, z, h, a, k_img_s, method=WorldModel.imagination
-                )
+                z, h = wm_apply(params["wm"], WorldModel.imagination, z, h, a, k_img_s)
                 state = jnp.concatenate([z, h], axis=-1)
-                pre = actor.apply({"params": actor_params}, jax.lax.stop_gradient(state))
+                pre = actor_apply(actor_params, jax.lax.stop_gradient(state))
                 acts, _ = sample_actor_actions(actor, pre, k_a)
                 a = jnp.concatenate(acts, axis=-1)
                 return (z, h, a), (state, a)
@@ -260,7 +264,7 @@ def make_train_fn(
 
         def intrinsic_reward(trajectories, imagined_actions):
             inp = jax.lax.stop_gradient(jnp.concatenate([trajectories, imagined_actions], -1))
-            preds = ens_apply(params["ensembles"], inp)  # [n, H+1, TB, Z]
+            preds = ens_apply_c(params["ensembles"], inp)  # [n, H+1, TB, Z]
             return jnp.var(preds, axis=0).mean(-1, keepdims=True) * intrinsic_mult
 
         def continues_of(trajectories):
@@ -290,9 +294,7 @@ def make_train_fn(
             lv_per_critic = {}
             for name, ccfg in critics_cfg.items():
                 values = TwoHotEncodingDistribution(
-                    critic.apply(
-                        {"params": params["critics_exploration"][name]["critic"]}, trajectories
-                    ),
+                    critic_apply(params["critics_exploration"][name]["critic"], trajectories),
                     dims=1,
                 ).mean
                 if ccfg["reward_type"] == "intrinsic":
@@ -310,7 +312,7 @@ def make_train_fn(
                     ccfg["weight"] / weights_sum
                 )
                 lv_per_critic[name] = jax.lax.stop_gradient(lv)
-            pre_dist = actor.apply({"params": actor_params}, jax.lax.stop_gradient(trajectories))
+            pre_dist = actor_apply(actor_params, jax.lax.stop_gradient(trajectories))
             dists = actor_dists(actor, pre_dist)
             objective = policy_objective(dists, imagined_actions, advantage)
             entropy = ent_coef * sum(d.entropy() for d in dists)[..., None]
@@ -340,12 +342,10 @@ def make_train_fn(
 
             def c_loss_fn(c_params, name=name):
                 qv = TwoHotEncodingDistribution(
-                    critic.apply({"params": c_params}, traj_sg[:-1]), dims=1
+                    critic_apply(c_params, traj_sg[:-1]), dims=1
                 )
                 tv = TwoHotEncodingDistribution(
-                    critic.apply(
-                        {"params": params["critics_exploration"][name]["target"]}, traj_sg[:-1]
-                    ),
+                    critic_apply(params["critics_exploration"][name]["target"], traj_sg[:-1]),
                     dims=1,
                 ).mean
                 loss = -qv.log_prob(lv_sg) - qv.log_prob(jax.lax.stop_gradient(tv))
@@ -368,7 +368,7 @@ def make_train_fn(
         def task_actor_loss_fn(actor_params, moments_task):
             trajectories, imagined_actions = rollout(actor_params, k_img_task)
             values = TwoHotEncodingDistribution(
-                critic.apply({"params": params["critic_task"]}, trajectories), dims=1
+                critic_apply(params["critic_task"], trajectories), dims=1
             ).mean
             rewards_img = TwoHotEncodingDistribution(
                 wm_apply(params["wm"], WorldModel.reward, trajectories), dims=1
@@ -380,7 +380,7 @@ def make_train_fn(
             normed_lv = (lv - offset) / invscale
             normed_baseline = (values[:-1] - offset) / invscale
             advantage = normed_lv - normed_baseline
-            pre_dist = actor.apply({"params": actor_params}, jax.lax.stop_gradient(trajectories))
+            pre_dist = actor_apply(actor_params, jax.lax.stop_gradient(trajectories))
             dists = actor_dists(actor, pre_dist)
             objective = policy_objective(dists, imagined_actions, advantage)
             entropy = ent_coef * sum(d.entropy() for d in dists)[..., None]
@@ -404,10 +404,10 @@ def make_train_fn(
 
         def task_critic_loss_fn(c_params):
             qv = TwoHotEncodingDistribution(
-                critic.apply({"params": c_params}, t_aux["trajectories"][:-1]), dims=1
+                critic_apply(c_params, t_aux["trajectories"][:-1]), dims=1
             )
             tv = TwoHotEncodingDistribution(
-                critic.apply({"params": params["target_critic_task"]}, t_aux["trajectories"][:-1]),
+                critic_apply(params["target_critic_task"], t_aux["trajectories"][:-1]),
                 dims=1,
             ).mean
             loss = -qv.log_prob(t_aux["lambda_values"]) - qv.log_prob(jax.lax.stop_gradient(tv))
