@@ -41,6 +41,7 @@ from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reach
 from ..dreamer_v2.dreamer_v2 import make_player as make_dreamer_player
 from .agent import DV1WorldModel, build_agent, dv2_sample_actions
 from .loss import actor_loss, critic_loss, reconstruction_loss
+from ..dreamer_v3.utils import make_precision_applies
 from .utils import (
     AGGREGATOR_KEYS,
     compute_lambda_values,
@@ -69,8 +70,8 @@ def make_train_fn(
     lmbda = float(cfg.algo.lmbda)
     use_continues = bool(wm_cfg.use_continues)
 
-    def wm_apply(p, method, *args):
-        return wm.apply({"params": p}, *args, method=method)
+    # mixed precision: shared cast boundary (dreamer_v3/utils.py)
+    wm_apply, actor_apply, critic_apply, *_ = make_precision_applies(cfg, wm, actor, critic)
 
     def one_step(params, opt_states, batch, key):
         T, B = batch["rewards"].shape[:2]
@@ -84,8 +85,8 @@ def make_train_fn(
             def dyn_step(carry, xs):
                 h, z = carry
                 a, e, k = xs
-                h, z, post_ms, prior_ms = wm.apply(
-                    {"params": wm_params}, z, h, a, e, k, method=DV1WorldModel.dynamic
+                h, z, post_ms, prior_ms = wm_apply(
+                    wm_params, DV1WorldModel.dynamic, z, h, a, e, k
                 )
                 return (h, z), (h, z, post_ms[0], post_ms[1], prior_ms[0], prior_ms[1])
 
@@ -156,12 +157,10 @@ def make_train_fn(
                 z, h = carry
                 k_a, k_i = jax.random.split(k)
                 latent = jnp.concatenate([z, h], axis=-1)
-                pre = actor.apply({"params": actor_params}, jax.lax.stop_gradient(latent))
+                pre = actor_apply(actor_params, jax.lax.stop_gradient(latent))
                 acts, _ = dv2_sample_actions(actor, pre, k_a)
                 a = jnp.concatenate(acts, axis=-1)
-                z, h = wm.apply(
-                    {"params": params["wm"]}, z, h, a, k_i, method=DV1WorldModel.imagination
-                )
+                z, h = wm_apply(params["wm"], DV1WorldModel.imagination, z, h, a, k_i)
                 return (z, h), jnp.concatenate([z, h], axis=-1)
 
             keys = jax.random.split(key, horizon)
@@ -170,7 +169,7 @@ def make_train_fn(
 
         def actor_loss_fn(actor_params):
             trajectories = rollout(actor_params, k_img)
-            predicted_values = critic.apply({"params": params["critic"]}, trajectories)
+            predicted_values = critic_apply(params["critic"], trajectories)
             predicted_rewards = wm_apply(params["wm"], DV1WorldModel.reward, trajectories)
             if use_continues:
                 continues = jax.nn.sigmoid(
@@ -210,7 +209,7 @@ def make_train_fn(
         # ---------------- critic ------------------------------------------
         def critic_loss_fn(critic_params):
             qv = Independent(
-                Normal(critic.apply({"params": critic_params}, a_aux["trajectories"][:-1]), 1.0), 1
+                Normal(critic_apply(critic_params, a_aux["trajectories"][:-1]), 1.0), 1
             )
             return critic_loss(qv, a_aux["lambda_values"], a_aux["discount"][..., 0])
 

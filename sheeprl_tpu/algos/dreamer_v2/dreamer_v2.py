@@ -53,6 +53,7 @@ from .agent import (
     dv2_sample_actions,
 )
 from .loss import reconstruction_loss
+from ..dreamer_v3.utils import make_precision_applies
 from .utils import (
     AGGREGATOR_KEYS,
     compute_lambda_values,
@@ -84,8 +85,8 @@ def make_train_fn(
     use_continues = bool(wm_cfg.use_continues)
     target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
 
-    def wm_apply(p, method, *args):
-        return wm.apply({"params": p}, *args, method=method)
+    # mixed precision: shared cast boundary (dreamer_v3/utils.py)
+    wm_apply, actor_apply, critic_apply, *_ = make_precision_applies(cfg, wm, actor, critic)
 
     def one_step(params, opt_states, batch, key):
         T, B = batch["rewards"].shape[:2]
@@ -108,8 +109,8 @@ def make_train_fn(
             def dyn_step(carry, xs):
                 h, z = carry
                 a, e, first, k = xs
-                h, z, post_logits, prior_logits = wm.apply(
-                    {"params": wm_params}, z, h, a, e, first, k, method=DV2WorldModel.dynamic
+                h, z, post_logits, prior_logits = wm_apply(
+                    wm_params, DV2WorldModel.dynamic, z, h, a, e, first, k
                 )
                 return (h, z), (h, z, post_logits, prior_logits)
 
@@ -178,12 +179,10 @@ def make_train_fn(
             def img_step(carry, k):
                 z, h, latent = carry
                 k_a, k_i = jax.random.split(k)
-                pre = actor.apply({"params": actor_params}, jax.lax.stop_gradient(latent))
+                pre = actor_apply(actor_params, jax.lax.stop_gradient(latent))
                 acts, _ = dv2_sample_actions(actor, pre, k_a)
                 a = jnp.concatenate(acts, axis=-1)
-                z, h = wm.apply(
-                    {"params": params["wm"]}, z, h, a, k_i, method=DV2WorldModel.imagination
-                )
+                z, h = wm_apply(params["wm"], DV2WorldModel.imagination, z, h, a, k_i)
                 latent = jnp.concatenate([z, h], axis=-1)
                 return (z, h, latent), (latent, a)
 
@@ -199,7 +198,7 @@ def make_train_fn(
 
         def actor_loss_fn(actor_params):
             trajectories, imagined_actions = rollout(actor_params, k_img)
-            target_values = critic.apply({"params": params["target_critic"]}, trajectories)
+            target_values = critic_apply(params["target_critic"], trajectories)
             rewards_img = wm_apply(params["wm"], DV2WorldModel.reward, trajectories)
             if use_continues:
                 continues = nnprobs(wm_apply(params["wm"], DV2WorldModel.cont, trajectories))
@@ -214,9 +213,7 @@ def make_train_fn(
             discount = jax.lax.stop_gradient(
                 jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-1]], 0), 0)
             )
-            pre_dist = actor.apply(
-                {"params": actor_params}, jax.lax.stop_gradient(trajectories[:-2])
-            )
+            pre_dist = actor_apply(actor_params, jax.lax.stop_gradient(trajectories[:-2]))
             dists = dv2_actor_dists(actor, pre_dist)
             dynamics = lv[1:]
             advantage = jax.lax.stop_gradient(lv[1:] - target_values[:-2])
@@ -252,7 +249,7 @@ def make_train_fn(
         discount = a_aux["discount"]
 
         def critic_loss_fn(critic_params):
-            qv = Independent(Normal(critic.apply({"params": critic_params}, traj_sg[:-1]), 1.0), 1)
+            qv = Independent(Normal(critic_apply(critic_params, traj_sg[:-1]), 1.0), 1)
             return -jnp.mean(discount[:-1, ..., 0] * qv.log_prob(lv_sg))
 
         value_loss, c_grads = jax.value_and_grad(critic_loss_fn)(params["critic"])

@@ -107,6 +107,17 @@ def make_precision_applies(cfg: Any, wm, actor, critic):
     return wm_apply, actor_apply, critic_apply, cast, compute_dtype, mixed
 
 
+def make_ens_apply(ens_apply, cast, compute_dtype):
+    """Cast-bounded ensemble forward for the P2E variants (same contract as
+    the applies above)."""
+    import jax.numpy as jnp
+
+    def ens_apply_c(p, x):
+        return cast(ens_apply(cast(p, compute_dtype), cast(x, compute_dtype)), jnp.float32)
+
+    return ens_apply_c
+
+
 def extract_masks(obs: Dict[str, Any], num_envs: int = 1):
     """Action-mask obs keys for the (Minedojo)Actor (reference
     dreamer_v3.py:574-577: every `mask*` obs key gates an actor head).

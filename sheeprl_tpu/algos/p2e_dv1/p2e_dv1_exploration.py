@@ -44,6 +44,7 @@ from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reach
 from ..dreamer_v1.agent import DV1WorldModel
 from ..dreamer_v1.loss import actor_loss, critic_loss, reconstruction_loss
 from ..dreamer_v1.utils import compute_lambda_values, normalize_obs, prepare_obs, test
+from ..dreamer_v3.utils import make_ens_apply, make_precision_applies
 from ..dreamer_v2.agent import dv2_sample_actions
 from ..dreamer_v1.dreamer_v1 import make_player as make_dv1_player
 from .agent import build_agent
@@ -101,8 +102,11 @@ def make_train_fn(
     use_continues = bool(wm_cfg.use_continues)
     intrinsic_mult = float(cfg.algo.intrinsic_reward_multiplier)
 
-    def wm_apply(p, method, *args):
-        return wm.apply({"params": p}, *args, method=method)
+    # mixed precision: shared cast boundary (dreamer_v3/utils.py)
+    wm_apply, actor_apply, critic_apply, _cast, _cdt, _ = make_precision_applies(
+        cfg, wm, actor, critic
+    )
+    ens_apply_c = make_ens_apply(ens_apply, _cast, _cdt)
 
     def one_step(params, opt_states, batch, key):
         T, B = batch["rewards"].shape[:2]
@@ -116,8 +120,8 @@ def make_train_fn(
             def dyn_step(carry, xs):
                 h, z = carry
                 a, e, k = xs
-                h, z, post_ms, prior_ms = wm.apply(
-                    {"params": wm_params}, z, h, a, e, k, method=DV1WorldModel.dynamic
+                h, z, post_ms, prior_ms = wm_apply(
+                    wm_params, DV1WorldModel.dynamic, z, h, a, e, k
                 )
                 return (h, z), (h, z, post_ms[0], post_ms[1], prior_ms[0], prior_ms[1])
 
@@ -187,7 +191,7 @@ def make_train_fn(
         # ---------------- 2. ensembles ------------------------------------
         def ens_loss_fn(ens_params):
             inp = jnp.concatenate([zs, hs, batch["actions"]], axis=-1)
-            out = ens_apply(ens_params, inp)[:, :-1]  # [n, T-1, B, E]
+            out = ens_apply_c(ens_params, inp)[:, :-1]  # [n, T-1, B, E]
             dist = Independent(Normal(out, 1.0), 1)
             return -jnp.sum(jnp.mean(dist.log_prob(embedded[None, 1:]), axis=(1, 2)))
 
@@ -206,12 +210,10 @@ def make_train_fn(
                 z, h = carry
                 k_a, k_i = jax.random.split(k)
                 latent = jnp.concatenate([z, h], axis=-1)
-                pre = actor.apply({"params": actor_params}, jax.lax.stop_gradient(latent))
+                pre = actor_apply(actor_params, jax.lax.stop_gradient(latent))
                 acts, _ = dv2_sample_actions(actor, pre, k_a)
                 a = jnp.concatenate(acts, axis=-1)
-                z, h = wm.apply(
-                    {"params": params["wm"]}, z, h, a, k_i, method=DV1WorldModel.imagination
-                )
+                z, h = wm_apply(params["wm"], DV1WorldModel.imagination, z, h, a, k_i)
                 return (z, h), (jnp.concatenate([z, h], axis=-1), a)
 
             keys = jax.random.split(key, horizon)
@@ -224,7 +226,7 @@ def make_train_fn(
 
             def actor_loss_fn(a_params):
                 trajectories, imagined_actions = rollout(a_params, key)
-                predicted_values = critic.apply({"params": critic_params}, trajectories)
+                predicted_values = critic_apply(critic_params, trajectories)
                 rewards_img = reward_fn(trajectories, imagined_actions)
                 if use_continues:
                     continues = jax.nn.sigmoid(
@@ -260,7 +262,7 @@ def make_train_fn(
 
             def critic_loss_fn(c_params):
                 qv = Independent(
-                    Normal(critic.apply({"params": c_params}, aux["trajectories"][:-1]), 1.0), 1
+                    Normal(critic_apply(c_params, aux["trajectories"][:-1]), 1.0), 1
                 )
                 return critic_loss(qv, aux["lambda_values"], aux["discount"][..., 0])
 
@@ -270,7 +272,7 @@ def make_train_fn(
         # ---------------- 3. exploration behaviour ------------------------
         def intrinsic_reward_fn(trajectories, imagined_actions):
             inp = jax.lax.stop_gradient(jnp.concatenate([trajectories, imagined_actions], -1))
-            preds = ens_apply(params["ensembles"], inp)  # [n, H, TB, E]
+            preds = ens_apply_c(params["ensembles"], inp)  # [n, H, TB, E]
             return jnp.var(preds, axis=0).mean(-1, keepdims=True) * intrinsic_mult
 
         policy_loss_expl, a_grads, value_loss_expl, c_grads, aux_expl = behaviour(

@@ -44,6 +44,7 @@ from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reach
 from ..dreamer_v2.agent import DV2WorldModel, dv2_actor_dists, dv2_sample_actions
 from ..dreamer_v2.dreamer_v2 import _build_buffer, make_player as make_dreamer_player
 from ..dreamer_v2.loss import reconstruction_loss
+from ..dreamer_v3.utils import make_ens_apply, make_precision_applies
 from ..dreamer_v2.utils import (
     compute_lambda_values,
     normalize_obs,
@@ -111,8 +112,11 @@ def make_train_fn(
     intrinsic_mult = float(cfg.algo.intrinsic_reward_multiplier)
     act_width = int(sum(actions_dim))
 
-    def wm_apply(p, method, *args):
-        return wm.apply({"params": p}, *args, method=method)
+    # mixed precision: shared cast boundary (dreamer_v3/utils.py)
+    wm_apply, actor_apply, critic_apply, _cast, _cdt, _ = make_precision_applies(
+        cfg, wm, actor, critic
+    )
+    ens_apply_c = make_ens_apply(ens_apply, _cast, _cdt)
 
     def one_step(params, opt_states, batch, key):
         T, B = batch["rewards"].shape[:2]
@@ -137,8 +141,8 @@ def make_train_fn(
             def dyn_step(carry, xs):
                 h, z = carry
                 a, e, first, k = xs
-                h, z, post_logits, prior_logits = wm.apply(
-                    {"params": wm_params}, z, h, a, e, first, k, method=DV2WorldModel.dynamic
+                h, z, post_logits, prior_logits = wm_apply(
+                    wm_params, DV2WorldModel.dynamic, z, h, a, e, first, k
                 )
                 return (h, z), (h, z, post_logits, prior_logits)
 
@@ -213,7 +217,7 @@ def make_train_fn(
         # ---------------- 2. ensembles ------------------------------------
         def ens_loss_fn(ens_params):
             inp = jnp.concatenate([zs, hs, batch["actions"]], axis=-1)
-            out = ens_apply(ens_params, inp)[:, :-1]
+            out = ens_apply_c(ens_params, inp)[:, :-1]
             dist = Independent(Normal(out, 1.0), 1)
             return -jnp.sum(jnp.mean(dist.log_prob(zs[None, 1:]), axis=(1, 2)))
 
@@ -234,12 +238,10 @@ def make_train_fn(
             def img_step(carry, k):
                 z, h, latent = carry
                 k_a, k_i = jax.random.split(k)
-                pre = actor.apply({"params": actor_params}, jax.lax.stop_gradient(latent))
+                pre = actor_apply(actor_params, jax.lax.stop_gradient(latent))
                 acts, _ = dv2_sample_actions(actor, pre, k_a)
                 a = jnp.concatenate(acts, axis=-1)
-                z, h = wm.apply(
-                    {"params": params["wm"]}, z, h, a, k_i, method=DV2WorldModel.imagination
-                )
+                z, h = wm_apply(params["wm"], DV2WorldModel.imagination, z, h, a, k_i)
                 latent = jnp.concatenate([z, h], axis=-1)
                 return (z, h, latent), (latent, a)
 
@@ -258,7 +260,7 @@ def make_train_fn(
 
             def actor_loss_fn(a_params):
                 trajectories, imagined_actions = rollout(a_params, key)
-                target_values = critic.apply({"params": target_params}, trajectories)
+                target_values = critic_apply(target_params, trajectories)
                 rewards_img = reward_fn(trajectories, imagined_actions)
                 if use_continues:
                     continues = jax.nn.sigmoid(
@@ -277,9 +279,7 @@ def make_train_fn(
                         jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-1]], 0), 0
                     )
                 )
-                pre_dist = actor.apply(
-                    {"params": a_params}, jax.lax.stop_gradient(trajectories[:-2])
-                )
+                pre_dist = actor_apply(a_params, jax.lax.stop_gradient(trajectories[:-2]))
                 dists = dv2_actor_dists(actor, pre_dist)
                 dynamics = lv[1:]
                 advantage = jax.lax.stop_gradient(lv[1:] - target_values[:-2])
@@ -313,7 +313,7 @@ def make_train_fn(
 
             def critic_loss_fn(c_params):
                 qv = Independent(
-                    Normal(critic.apply({"params": c_params}, aux["trajectories"][:-1]), 1.0), 1
+                    Normal(critic_apply(c_params, aux["trajectories"][:-1]), 1.0), 1
                 )
                 return -jnp.mean(aux["discount"][:-1, ..., 0] * qv.log_prob(aux["lambda_values"]))
 
@@ -323,7 +323,7 @@ def make_train_fn(
         # ---------------- 3. exploration behaviour ------------------------
         def intrinsic_reward_fn(trajectories, imagined_actions):
             inp = jax.lax.stop_gradient(jnp.concatenate([trajectories, imagined_actions], -1))
-            preds = ens_apply(params["ensembles"], inp)
+            preds = ens_apply_c(params["ensembles"], inp)
             return jnp.var(preds, axis=0).mean(-1, keepdims=True) * intrinsic_mult
 
         policy_loss_expl, a_grads, value_loss_expl, c_grads, aux_expl = behaviour(

@@ -57,8 +57,9 @@ from ..dreamer_v3.dreamer_v3 import make_player
 from ..dreamer_v3.loss import reconstruction_loss
 from ..dreamer_v3.utils import (  # noqa: F401
     extract_masks,
-    make_precision_applies,
     init_moments,
+    make_ens_apply,
+    make_precision_applies,
     normalize_obs,
     prepare_obs,
     test,
@@ -125,12 +126,10 @@ def make_train_fn(
     weights_sum = sum(c["weight"] for c in critics_cfg.values())
 
     # mixed precision: shared cast boundary (dreamer_v3/utils.py)
-    wm_apply, actor_apply, critic_apply, _cast, compute_dtype, mixed = make_precision_applies(
+    wm_apply, actor_apply, critic_apply, _cast, _cdt, _ = make_precision_applies(
         cfg, wm, actor, critic
     )
-
-    def ens_apply_c(p, x):
-        return _cast(ens_apply(_cast(p, compute_dtype), _cast(x, compute_dtype)), jnp.float32)
+    ens_apply_c = make_ens_apply(ens_apply, _cast, _cdt)
 
     def moments_step(moments, lv):
         return update_moments(
