@@ -68,10 +68,14 @@ def record() -> dict:
     size = os.environ.get("BENCH_DV3_SIZE", "")
     batch = int(os.environ.get("BENCH_DV3_BATCH", BATCH))
     seq = int(os.environ.get("BENCH_DV3_SEQ", SEQ))
+    # BENCH_DV3_PRECISION=bf16-mixed measures the MXU's native reduced
+    # precision (the production recipe default stays f32 for baseline parity)
+    precision = os.environ.get("BENCH_DV3_PRECISION", "")
     cfg = compose(
         "config",
         ["exp=dreamer_v3_100k_ms_pacman"]
         + ([f"algo=dreamer_v3_{size}"] if size else [])
+        + ([f"fabric.precision={precision}"] if precision else [])
         + [
             "env=dummy",
             "env.id=discrete_dummy",
@@ -180,6 +184,7 @@ def record() -> dict:
         "vs_baseline": round(sps / BASELINE_STEPS_PER_SEC, 3),
         "platform": jax.devices()[0].platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+        "precision": str(cfg.fabric.precision),
     }
     if flops_per_step is not None:
         rec["model_flops_per_step"] = flops_per_step
