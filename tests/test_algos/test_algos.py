@@ -397,6 +397,39 @@ def test_p2e_dv2(standard_args, env_id, tmp_path):
     )
 
 
+@pytest.mark.full
+def test_p2e_dv3_bf16_mixed(standard_args):
+    """The most complex train fn (multi-critic P2E exploration) stays
+    finite under fabric.precision=bf16-mixed (the doapp recipes' setting)."""
+    _run(
+        [
+            "exp=p2e_dv3_exploration",
+            "algo.name=p2e_dv3_exploration",
+            "algo=p2e_dv3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "fabric.precision=bf16-mixed",
+            "algo.per_rank_batch_size=2",
+            "algo.per_rank_sequence_length=2",
+            "algo.learning_starts=0",
+            "algo.horizon=4",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.ensembles.n=3",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=16",
+            "algo.world_model.representation_model.hidden_size=16",
+            "algo.world_model.transition_model.hidden_size=16",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.stochastic_size=4",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.size=64",
+        ],
+        standard_args,
+    )
+
+
 def test_ppo_decoupled(standard_args):
     common = [
         "exp=ppo_decoupled",
