@@ -230,9 +230,10 @@ def main() -> None:
         # legs, past bench runs AND regular `sheeprl_tpu run` invocations
         # (same default as utils.enable_compilation_cache): a DV3 compile
         # costs tens of seconds on TPU and a flaky link means retries
+        from sheeprl_tpu.utils.utils import DEFAULT_XLA_CACHE_DIR
+
         os.environ.setdefault(
-            "JAX_COMPILATION_CACHE_DIR",
-            os.path.expanduser("~/.cache/sheeprl_tpu/xla_cache"),
+            "JAX_COMPILATION_CACHE_DIR", os.path.expanduser(DEFAULT_XLA_CACHE_DIR)
         )
         preflight_budget = float(os.environ.get("BENCH_PREFLIGHT_BUDGET_S", 180))
         retries = max(1, int(os.environ.get("BENCH_PREFLIGHT_RETRIES", 3)))

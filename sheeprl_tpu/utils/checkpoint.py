@@ -27,6 +27,11 @@ def _fetch_global(x: Any) -> np.ndarray:
     CheckpointManager.save)."""
     if getattr(x, "is_fully_addressable", True):
         return np.asarray(jax.device_get(x))
+    sharding = getattr(x, "sharding", None)
+    if sharding is not None and getattr(sharding, "is_fully_replicated", False):
+        # replicated across hosts: every process already holds a complete
+        # copy — read it locally instead of paying a cross-host all-gather
+        return np.asarray(x.addressable_data(0))
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))

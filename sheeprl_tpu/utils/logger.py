@@ -162,4 +162,9 @@ def get_logger(cfg: Config, log_dir: str, process_index: int = 0):
     (select with `logger@metric.logger=mlflow`, reference configs/logger)."""
     if process_index != 0 or cfg.select("metric.log_level", 1) == 0:
         return None
-    return _build_logger(cfg, log_dir)
+    logger = _build_logger(cfg, log_dir)
+    try:
+        logger.log_hyperparams(cfg.to_dict())
+    except Exception as err:  # hyperparams are best-effort; metrics must flow
+        print(f"[logger] log_hyperparams failed: {err}")
+    return logger

@@ -85,6 +85,9 @@ def save_configs(cfg: Any, log_dir: str) -> None:
     save_config(cfg, f"{log_dir}/config.yaml")
 
 
+DEFAULT_XLA_CACHE_DIR = "~/.cache/sheeprl_tpu/xla_cache"
+
+
 def enable_compilation_cache() -> None:
     """Persistent XLA compilation cache: the DreamerV3 train program takes
     tens of seconds to compile on TPU, and on a flaky-link machine every
@@ -98,7 +101,7 @@ def enable_compilation_cache() -> None:
     import jax
 
     cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.expanduser(
-        "~/.cache/sheeprl_tpu/xla_cache"
+        DEFAULT_XLA_CACHE_DIR
     )
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -65,4 +65,4 @@ def train_burst(overrides, seq_len: int = 4, batch_size: int = 2, seed: int = 7)
 
 def burst_metrics(overrides, **kw):
     _, _, _, metrics = train_burst(overrides, **kw)
-    return {k: float(np.asarray(v)) for k, v in metrics.items()}
+    return {k: float(np.asarray(v).mean()) for k, v in metrics.items()}

@@ -155,10 +155,13 @@ def test_mlflow_logger_with_stub(monkeypatch, tmp_path):
     logger = get_logger(cfg, str(tmp_path))
     assert isinstance(logger, MLflowLogger) and logger.run_id == "r1"
     assert calls["experiment"] == "ppo/discrete_dummy"
+    # get_logger logs the full composed config as hyperparams up front
+    assert calls["params"], "run hyperparams were not logged at construction"
+    assert any("algo.name" in chunk for chunk in calls["params"])
     logger.log_metrics({"Loss/x": np.float32(1.5), "bad": object()}, step=7)
     assert calls["metrics"] == [({"Loss/x": 1.5}, 7)]
     logger.log_hyperparams({"algo": {"lr": 1e-3}, "seed": 42})
-    assert calls["params"] == [{"algo.lr": 0.001, "seed": 42}]
+    assert calls["params"][-1] == {"algo.lr": 0.001, "seed": 42}
     logger.close()
     assert calls["ended"] == 1
 
