@@ -34,10 +34,9 @@ TINY_DV3 = [
 N_ACT = 4
 
 
-def train_burst(overrides, seq_len: int = 4, batch_size: int = 2, seed: int = 7):
-    """Build the tiny agent with TINY_DV3 + overrides and run ONE train
-    burst on a deterministic synthetic batch. Returns (params, opt_states,
-    moments, metrics)."""
+def make_trainer(overrides=()):
+    """Tiny agent + optimizers + jitted train fn from TINY_DV3 + overrides.
+    Returns (train, params, opt_states, moments)."""
     cfg = compose("config", TINY_DV3 + list(overrides))
     dist = Distributed(devices=1)
     obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8)})
@@ -46,6 +45,14 @@ def train_burst(overrides, seq_len: int = 4, batch_size: int = 2, seed: int = 7)
     )
     txs, opt_states = build_optimizers(cfg, params)
     train = make_train_fn(wm, actor, critic, txs, cfg, False, [N_ACT])
+    return train, params, opt_states, init_moments()
+
+
+def train_burst(overrides, seq_len: int = 4, batch_size: int = 2, seed: int = 7):
+    """Build the tiny agent with TINY_DV3 + overrides and run ONE train
+    burst on a deterministic synthetic batch. Returns (params, opt_states,
+    moments, metrics)."""
+    train, params, opt_states, moments = make_trainer(overrides)
     rng = np.random.default_rng(0)
     T, B = seq_len, batch_size
     batch = {
@@ -59,7 +66,7 @@ def train_burst(overrides, seq_len: int = 4, batch_size: int = 2, seed: int = 7)
         "is_first": jnp.zeros((1, T, B, 1), jnp.float32),
     }
     return train(
-        params, opt_states, init_moments(), batch, jax.random.split(jax.random.key(seed), 1)
+        params, opt_states, moments, batch, jax.random.split(jax.random.key(seed), 1)
     )
 
 

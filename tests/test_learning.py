@@ -54,3 +54,45 @@ def test_ppo_cartpole_learns_past_400():
     test_rew = _tb_series("logs/runs/ppo", "Test/cumulative_reward")
     if test_rew:  # greedy post-training test episode
         assert test_rew[-1][1] >= 400.0
+
+
+@pytest.mark.slow
+def test_dreamer_v3_world_model_optimizes():
+    """End-to-end learning dynamics of the flagship: on a FIXED replay batch
+    (dummy-env counter frames), the full jitted DV3 train program must drive
+    the observation loss down across bursts — gradient flow through
+    encoder→scan→decoder and the optimizer chain all working."""
+    import jax
+    import jax.numpy as jnp
+
+    from dreamer_tiny import N_ACT, make_trainer
+
+    train, params, opt_states, moments = make_trainer()
+
+    rng = np.random.default_rng(0)
+    T, B, G = 4, 2, 8
+    rgb = np.zeros((G, T, B, 64, 64, 3), np.uint8)
+    for g in range(G):
+        for b in range(B):
+            c0 = rng.integers(0, 200)
+            for t in range(T):
+                rgb[g, t, b] = (c0 + t) % 256  # the dummy env's dynamic
+    fixed = {
+        "rgb": jnp.asarray(rgb),
+        "actions": jnp.asarray(np.eye(N_ACT, dtype=np.float32)[rng.integers(0, N_ACT, (G, T, B))]),
+        "rewards": jnp.zeros((G, T, B, 1), jnp.float32),
+        "terminated": jnp.zeros((G, T, B, 1), jnp.float32),
+        "truncated": jnp.zeros((G, T, B, 1), jnp.float32),
+        "is_first": jnp.zeros((G, T, B, 1), jnp.float32),
+    }
+    key = jax.random.key(1)
+    losses = []
+    for _ in range(8):
+        key, k = jax.random.split(key)
+        params, opt_states, moments, m = train(
+            params, opt_states, moments, fixed, jax.random.split(k, G)
+        )
+        losses.append(float(np.asarray(m["Loss/observation_loss"]).mean()))
+    # the trend is the proof; per-burst monotonicity would be numerics-flaky
+    assert losses[-1] < 0.95 * losses[0], losses  # >5% drop over 64 grad steps
+    assert all(np.isfinite(losses)), losses
