@@ -21,7 +21,7 @@ import os
 import sys
 import time
 from functools import partial
-from typing import Any, Dict, Optional, Sequence
+from typing import Any, Dict, Sequence
 
 import gymnasium as gym
 import jax

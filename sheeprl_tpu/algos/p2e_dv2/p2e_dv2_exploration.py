@@ -18,7 +18,6 @@ Both target critics are hard-copied every
 """
 from __future__ import annotations
 
-import os
 from functools import partial
 from typing import Any, Dict, Sequence
 
