@@ -176,3 +176,50 @@ def test_eval_round_trip_sac():
     )
     ckpt = _latest_ckpt("logs/runs/sac/continuous_dummy/*/version_*/checkpoint/ckpt_*.ckpt")
     evaluation([f"checkpoint_path={ckpt}"])
+
+
+DV3_TINY = [
+    "exp=dreamer_v3",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "algo=dreamer_v3_XS",
+    "algo.per_rank_batch_size=2",
+    "algo.per_rank_sequence_length=2",
+    "algo.learning_starts=4",
+    "algo.horizon=4",
+    "algo.dense_units=16",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=16",
+    "algo.world_model.transition_model.hidden_size=16",
+    "algo.world_model.representation_model.hidden_size=16",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.total_steps=16",
+    "algo.run_test=False",
+    "buffer.size=64",
+    "buffer.memmap=False",
+    "buffer.checkpoint=True",
+    "metric.log_level=0",
+    "checkpoint.every=8",
+]
+
+
+@pytest.mark.full
+def test_dreamer_v3_resume_continues_counters():
+    """Flagship resume round trip: counters, PRNG key and the replay buffer
+    ride the checkpoint; the resumed run advances past the original stop."""
+    pattern = "logs/runs/dreamer_v3/discrete_dummy/*/version_*/checkpoint/ckpt_*.ckpt"
+    run(DV3_TINY)
+    ckpt = _latest_ckpt(pattern)
+    start = CheckpointManager.load(ckpt)
+    assert start["policy_step"] > 0
+    assert "rb" in start, "buffer.checkpoint=True must persist the replay buffer"
+    assert "rng" in start
+    run(DV3_TINY + [f"checkpoint.resume_from={ckpt}", "algo.total_steps=32"])
+    resumed = CheckpointManager.load(_latest_ckpt(pattern))
+    assert resumed["policy_step"] > start["policy_step"]
