@@ -223,3 +223,51 @@ def test_dreamer_v3_resume_continues_counters():
     run(DV3_TINY + [f"checkpoint.resume_from={ckpt}", "algo.total_steps=32"])
     resumed = CheckpointManager.load(_latest_ckpt(pattern))
     assert resumed["policy_step"] > start["policy_step"]
+
+
+def test_available_agents_lists_all(capsys, monkeypatch):
+    """`sheeprl_tpu agents` prints every registered algorithm (reference
+    available_agents.py)."""
+    import re
+
+    from sheeprl_tpu.cli import available_agents
+    from sheeprl_tpu.utils.registry import algorithm_registry
+
+    monkeypatch.setenv("COLUMNS", "200")  # rich truncates cells on narrow consoles
+    available_agents()
+    out = capsys.readouterr().out
+    for name in algorithm_registry:
+        # whole-word match: "sac" inside "sac_ae" must not satisfy the check
+        assert re.search(rf"\b{re.escape(name)}\b", out), f"{name} missing from agents table"
+
+
+@pytest.mark.full
+def test_eval_round_trip_sac_ae():
+    """Eval round trip for the pixel autoencoder algorithm (its own
+    build/eval path, unlike sac/droq which share the SAC template)."""
+    run(
+        [
+            "exp=sac_ae",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "algo.per_rank_batch_size=4",
+            "algo.hidden_size=8",
+            "algo.dense_units=8",
+            "algo.cnn_channels_multiplier=1",
+            "algo.encoder.features_dim=8",
+            "algo.learning_starts=8",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.total_steps=16",
+            "algo.run_test=False",
+            "buffer.size=32",
+            "buffer.memmap=False",
+            "metric.log_level=0",
+            "checkpoint.every=8",
+        ]
+    )
+    ckpt = _latest_ckpt("logs/runs/sac_ae/continuous_dummy/*/version_*/checkpoint/ckpt_*.ckpt")
+    evaluation([f"checkpoint_path={ckpt}"])
