@@ -271,3 +271,73 @@ def test_eval_round_trip_sac_ae():
     )
     ckpt = _latest_ckpt("logs/runs/sac_ae/continuous_dummy/*/version_*/checkpoint/ckpt_*.ckpt")
     evaluation([f"checkpoint_path={ckpt}"])
+
+
+_EVAL_SWEEP = {
+    "a2c": [
+        "exp=a2c", "env.id=discrete_dummy", "algo.rollout_steps=4",
+        "algo.mlp_keys.encoder=[state]", "algo.dense_units=8", "algo.mlp_layers=1",
+        "algo.total_steps=16", "checkpoint.every=8",
+    ],
+    "ppo_recurrent": [
+        "exp=ppo_recurrent", "env.id=discrete_dummy", "algo.rollout_steps=8",
+        "algo.per_rank_sequence_length=4", "algo.per_rank_num_batches=2",
+        "algo.update_epochs=1", "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]", "algo.dense_units=8",
+        "algo.rnn.lstm.hidden_size=8", "algo.mlp_layers=1",
+        "algo.total_steps=16", "checkpoint.every=8",
+    ],
+    "droq": [
+        "exp=droq", "env.id=continuous_dummy", "algo.per_rank_batch_size=4",
+        "algo.hidden_size=8", "algo.learning_starts=4",
+        "algo.mlp_keys.encoder=[state]", "buffer.size=64",
+        "algo.total_steps=16", "checkpoint.every=8",
+    ],
+    "dreamer_v2": [
+        "exp=dreamer_v2", "env.id=discrete_dummy", "algo.per_rank_batch_size=2",
+        "algo.per_rank_sequence_length=2", "algo.per_rank_pretrain_steps=1",
+        "algo.learning_starts=4", "algo.horizon=4", "algo.dense_units=8",
+        "algo.mlp_layers=1", "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=16",
+        "algo.world_model.transition_model.hidden_size=16",
+        "algo.world_model.representation_model.hidden_size=16",
+        "algo.world_model.discrete_size=4", "algo.world_model.stochastic_size=4",
+        "algo.cnn_keys.encoder=[rgb]", "algo.mlp_keys.encoder=[state]",
+        "buffer.size=64", "algo.total_steps=16", "checkpoint.every=8",
+    ],
+    "dreamer_v1": [
+        "exp=dreamer_v1", "env.id=discrete_dummy", "algo.per_rank_batch_size=2",
+        "algo.per_rank_sequence_length=2", "algo.learning_starts=4",
+        "algo.horizon=4", "algo.dense_units=8", "algo.mlp_layers=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=16",
+        "algo.world_model.transition_model.hidden_size=16",
+        "algo.world_model.representation_model.hidden_size=16",
+        "algo.world_model.stochastic_size=4",
+        "algo.cnn_keys.encoder=[rgb]", "algo.mlp_keys.encoder=[state]",
+        "buffer.size=64", "algo.total_steps=16", "checkpoint.every=8",
+    ],
+    "dreamer_v3": DV3_TINY,
+}
+
+
+def _env_id_of(args):
+    return next(a.split("=", 1)[1] for a in args if a.startswith("env.id="))
+
+
+@pytest.mark.full
+@pytest.mark.parametrize("algo", sorted(_EVAL_SWEEP))
+def test_eval_round_trip_sweep(algo):
+    """`eval` works on a fresh checkpoint of each single-phase entry point
+    not covered by the dedicated round trips above (reference ships an
+    evaluate.py per algorithm). The P2E evaluations are exercised by their
+    exploration→finetuning e2e handoffs, which rebuild agents from the same
+    checkpoints."""
+    common = [
+        "env=dummy", "env.num_envs=2", "env.sync_env=True", "env.capture_video=False",
+        "algo.run_test=False", "buffer.memmap=False", "metric.log_level=0",
+    ]
+    run(_EVAL_SWEEP[algo] + common)
+    env_id = _env_id_of(_EVAL_SWEEP[algo])
+    ckpt = _latest_ckpt(f"logs/runs/{algo}/{env_id}/*/version_*/checkpoint/ckpt_*.ckpt")
+    evaluation([f"checkpoint_path={ckpt}"])
