@@ -330,9 +330,8 @@ def _env_id_of(args):
 def test_eval_round_trip_sweep(algo):
     """`eval` works on a fresh checkpoint of each single-phase entry point
     not covered by the dedicated round trips above (reference ships an
-    evaluate.py per algorithm). The P2E evaluations are exercised by their
-    exploration→finetuning e2e handoffs, which rebuild agents from the same
-    checkpoints."""
+    evaluate.py per algorithm; the P2E evaluation has its own round trip
+    below)."""
     common = [
         "env=dummy", "env.num_envs=2", "env.sync_env=True", "env.capture_video=False",
         "algo.run_test=False", "buffer.memmap=False", "metric.log_level=0",
@@ -340,4 +339,47 @@ def test_eval_round_trip_sweep(algo):
     run(_EVAL_SWEEP[algo] + common)
     env_id = _env_id_of(_EVAL_SWEEP[algo])
     ckpt = _latest_ckpt(f"logs/runs/{algo}/{env_id}/*/version_*/checkpoint/ckpt_*.ckpt")
+    evaluation([f"checkpoint_path={ckpt}"])
+
+
+@pytest.mark.full
+def test_eval_round_trip_p2e_dv3_exploration():
+    """The registered P2E evaluation rebuilds the zero-shot task agent from
+    an exploration checkpoint (reference p2e_dv3/evaluate.py)."""
+    run(
+        [
+            "exp=p2e_dv3_exploration",
+            "algo.name=p2e_dv3_exploration",
+            "algo=p2e_dv3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "algo.per_rank_batch_size=2",
+            "algo.per_rank_sequence_length=2",
+            "algo.learning_starts=4",
+            "algo.horizon=4",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.ensembles.n=3",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=16",
+            "algo.world_model.representation_model.hidden_size=16",
+            "algo.world_model.transition_model.hidden_size=16",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.stochastic_size=4",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.total_steps=16",
+            "algo.run_test=False",
+            "buffer.size=64",
+            "buffer.memmap=False",
+            "metric.log_level=0",
+            "checkpoint.every=8",
+        ]
+    )
+    ckpt = _latest_ckpt(
+        "logs/runs/p2e_dv3_exploration/discrete_dummy/*/version_*/checkpoint/ckpt_*.ckpt"
+    )
     evaluation([f"checkpoint_path={ckpt}"])
