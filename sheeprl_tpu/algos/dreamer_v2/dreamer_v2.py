@@ -31,8 +31,8 @@ from ...data import (
     EnvIndependentReplayBuffer,
     EpisodeBuffer,
     SequentialReplayBuffer,
-    StagedPrefetcher,
 )
+from ...data.device_ring import estimate_row_bytes, make_sequential_prefetcher
 from ...distributions import Bernoulli, Independent, Normal
 from ...optim import clipped
 from ...parallel import Distributed
@@ -514,7 +514,16 @@ def main(dist: Distributed, cfg: Config) -> None:
             for k, v in s.items()
         }
 
-    prefetch = StagedPrefetcher(_host_sample, dist.sharding(None, None, "dp"))
+    prefetch = make_sequential_prefetcher(
+        cfg,
+        dist,
+        rb,
+        batch_size,
+        seq_len,
+        cnn_keys=cnn_keys,
+        host_sample_fn=_host_sample,
+        row_bytes_hint=estimate_row_bytes(obs_space, sum(actions_dim)),
+    )
     pending_metrics: list = []
 
     obs, _ = envs.reset(seed=cfg.seed)

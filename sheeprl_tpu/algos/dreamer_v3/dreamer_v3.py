@@ -30,7 +30,8 @@ import numpy as np
 import optax
 
 from ...config import Config, instantiate
-from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer, StagedPrefetcher
+from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from ...data.device_ring import estimate_row_bytes, make_sequential_prefetcher
 from ...distributions import (
     BernoulliSafeMode,
     Independent,
@@ -556,12 +557,17 @@ def main(dist: Distributed, cfg: Config) -> None:
     last_checkpoint = state["last_checkpoint"] if state else 0
     clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
 
-    # [G, T, B, ...] replay batches, batch axis sharded over dp
-    prefetch = StagedPrefetcher(
-        lambda g: jax.tree.map(
-            np.asarray, rb.sample(batch_size, sequence_length=seq_len, n_samples=g)
-        ),
-        dist.sharding(None, None, "dp"),
+    # [G, T, B, ...] replay batches: HBM-resident ring (rows cross the link
+    # once, batches gather on device) on a single remote accelerator, else
+    # host-sampled + dp-sharded staging (data/device_ring.py)
+    prefetch = make_sequential_prefetcher(
+        cfg,
+        dist,
+        rb,
+        batch_size,
+        seq_len,
+        cnn_keys=cnn_keys,
+        row_bytes_hint=estimate_row_bytes(obs_space, act_total),
     )
     pending_metrics: list = []
 
@@ -691,9 +697,13 @@ def main(dist: Distributed, cfg: Config) -> None:
         if policy_step >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step / dist.world_size)
             if per_rank_gradient_steps > 0:
+                _trace = os.environ.get("SHEEPRL_TPU_TRACE")
                 with timer("Time/train_time"):
+                    _tt = time.perf_counter()
                     batches = prefetch.take(per_rank_gradient_steps)  # [G, T, B, ...]
+                    _t_take = time.perf_counter()
                     root_key, sub = jax.random.split(root_key)
+                    _t_split = time.perf_counter()
                     params, opt_states, moments, metrics = train(
                         params,
                         opt_states,
@@ -701,16 +711,33 @@ def main(dist: Distributed, cfg: Config) -> None:
                         batches,
                         jax.random.split(sub, per_rank_gradient_steps),
                     )
+                    _t_disp = time.perf_counter()
                 # metrics stay on device until log time — no per-step host sync
                 if not MetricAggregator.disabled:
                     # device refs held until the log-cadence host sync;
                     # skip entirely when metrics are off (bench legs)
                     pending_metrics.append(metrics)
+                if _trace:
+                    jax.tree.leaves(params)[0].block_until_ready()
+                    _t_exec = time.perf_counter()
                 mirror.refresh({"wm": params["wm"], "actor": params["actor"]})
+                if _trace:
+                    jax.tree.leaves(mirror._pending or mirror.params)[0].block_until_ready()
+                    _t_done = time.perf_counter()
+                    print(
+                        f"[trace] burst G={per_rank_gradient_steps} take={_t_take - _tt:.3f}"
+                        f" split={_t_split - _t_take:.3f} dispatch={_t_disp - _t_split:.3f}"
+                        f" exec={_t_exec - _t_disp:.3f} refresh={_t_done - _t_exec:.3f}",
+                        file=sys.stderr,
+                        flush=True,
+                    )
             if policy_step < total_steps:
                 # overlap the next sample + host→HBM transfer with the train
                 # step the device is computing right now
+                _tt = time.perf_counter()
                 prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
+                if per_rank_gradient_steps > 0 and os.environ.get("SHEEPRL_TPU_TRACE"):
+                    print(f"[trace] stage={time.perf_counter() - _tt:.3f}", file=sys.stderr, flush=True)
 
         if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
             for m in pending_metrics:  # host-sync deferred to log cadence

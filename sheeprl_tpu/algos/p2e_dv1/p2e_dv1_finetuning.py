@@ -19,8 +19,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...config import Config, instantiate
-from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer, StagedPrefetcher
+from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from ...optim import clipped
+from ...data.device_ring import estimate_row_bytes, make_sequential_prefetcher
 from ...parallel import Distributed
 from ...parallel.placement import make_param_mirror
 from ...utils.checkpoint import CheckpointManager
@@ -158,7 +159,16 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
             for k, v in s.items()
         }
 
-    prefetch = StagedPrefetcher(_host_sample, dist.sharding(None, None, "dp"))
+    prefetch = make_sequential_prefetcher(
+        cfg,
+        dist,
+        rb,
+        batch_size,
+        seq_len,
+        cnn_keys=cnn_keys,
+        host_sample_fn=_host_sample,
+        row_bytes_hint=estimate_row_bytes(obs_space, sum(actions_dim)),
+    )
     pending_metrics: list = []
 
     def _sp():

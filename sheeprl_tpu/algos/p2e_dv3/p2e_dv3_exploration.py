@@ -31,7 +31,8 @@ import numpy as np
 import optax
 
 from ...config import Config, instantiate
-from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer, StagedPrefetcher
+from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from ...data.device_ring import estimate_row_bytes, make_sequential_prefetcher
 from ...distributions import (
     BernoulliSafeMode,
     Independent,
@@ -597,11 +598,14 @@ def main(dist: Distributed, cfg: Config) -> None:
     last_checkpoint = state["last_checkpoint"] if state else 0
     clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
 
-    prefetch = StagedPrefetcher(
-        lambda g: jax.tree.map(
-            np.asarray, rb.sample(batch_size, sequence_length=seq_len, n_samples=g)
-        ),
-        dist.sharding(None, None, "dp"),
+    prefetch = make_sequential_prefetcher(
+        cfg,
+        dist,
+        rb,
+        batch_size,
+        seq_len,
+        cnn_keys=cnn_keys,
+        row_bytes_hint=estimate_row_bytes(obs_space, sum(actions_dim)),
     )
     pending_metrics: list = []
 

@@ -16,8 +16,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...config import Config, instantiate
-from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer, StagedPrefetcher
+from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from ...optim import clipped
+from ...data.device_ring import estimate_row_bytes, make_sequential_prefetcher
 from ...parallel import Distributed
 from ...parallel.mesh import maybe_shard_opt_state
 from ...parallel.placement import make_param_mirror
@@ -162,11 +163,14 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
         cfg, dist.local_device, step_params(), root_key
     )
 
-    prefetch = StagedPrefetcher(
-        lambda g: jax.tree.map(
-            np.asarray, rb.sample(batch_size, sequence_length=seq_len, n_samples=g)
-        ),
-        dist.sharding(None, None, "dp"),
+    prefetch = make_sequential_prefetcher(
+        cfg,
+        dist,
+        rb,
+        batch_size,
+        seq_len,
+        cnn_keys=cnn_keys,
+        row_bytes_hint=estimate_row_bytes(obs_space, sum(actions_dim)),
     )
     pending_metrics: list = []
 

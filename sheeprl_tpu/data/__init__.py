@@ -6,6 +6,7 @@ from .buffers import (
 )
 from .memmap import MemmapArray
 from .prefetch import DevicePrefetcher, StagedPrefetcher
+from .device_ring import DeviceRingPrefetcher, estimate_row_bytes, make_sequential_prefetcher
 
 __all__ = [
     "EnvIndependentReplayBuffer",
@@ -14,5 +15,8 @@ __all__ = [
     "SequentialReplayBuffer",
     "MemmapArray",
     "DevicePrefetcher",
+    "DeviceRingPrefetcher",
     "StagedPrefetcher",
+    "estimate_row_bytes",
+    "make_sequential_prefetcher",
 ]

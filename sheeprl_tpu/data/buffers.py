@@ -65,6 +65,10 @@ class ReplayBuffer:
         self._buf: Dict[str, Any] = {}
         self._pos = 0
         self._full = False
+        # monotonic count of rows ever added — lets the device-ring mirror
+        # detect when more than buffer_size rows landed between two syncs
+        # (a circular-_pos delta aliases in that case)
+        self._added = 0
 
     # -- properties --------------------------------------------------------
     @property
@@ -150,6 +154,7 @@ class ReplayBuffer:
         if self._pos + t >= self._buffer_size:
             self._full = True
         self._pos = int((self._pos + t) % self._buffer_size)
+        self._added += t
 
     # -- sample ------------------------------------------------------------
     def sample(
@@ -246,6 +251,7 @@ class ReplayBuffer:
             self._buf[k][:] = v
         self._pos = int(state["pos"])
         self._full = bool(state["full"])
+        self._added = int(state["pos"]) + (self._buffer_size if state["full"] else 0)
         return self
 
     @staticmethod
@@ -262,6 +268,24 @@ class SequentialReplayBuffer(ReplayBuffer):
 
     batch_axis: int = 2
 
+    def sample_starts(self, total: int, sequence_length: int) -> np.ndarray:
+        """Draw `total` valid window-start indices (the index math of
+        reference buffers.py:439-460, shared with the device-ring gather so
+        host and HBM sampling stay in lockstep)."""
+        L = sequence_length
+        if not self._full and self._pos - L + 1 < 1:
+            raise ValueError(
+                f"Cannot sample a sequence of length {L}: only {self._pos} steps stored"
+            )
+        if self._full:
+            # valid starts: any index such that the window [s, s+L) does not
+            # cross the write head
+            first_valid = self._pos
+            n_valid = self._buffer_size - L + 1
+            offsets = np.random.randint(0, n_valid, size=total)
+            return (first_valid + offsets) % self._buffer_size
+        return np.random.randint(0, self._pos - L + 1, size=total)
+
     def sample(  # type: ignore[override]
         self,
         batch_size: int,
@@ -276,20 +300,8 @@ class SequentialReplayBuffer(ReplayBuffer):
         if not self._full and self._pos == 0:
             raise ValueError("No data in the buffer, cannot sample")
         L = sequence_length
-        if not self._full and self._pos - L + 1 < 1:
-            raise ValueError(
-                f"Cannot sample a sequence of length {L}: only {self._pos} steps stored"
-            )
         total = batch_size * n_samples
-        if self._full:
-            # valid starts: any index such that the window [s, s+L) does not
-            # cross the write head (reference :439-460)
-            first_valid = self._pos
-            n_valid = self._buffer_size - L + 1
-            offsets = np.random.randint(0, n_valid, size=total)
-            starts = (first_valid + offsets) % self._buffer_size
-        else:
-            starts = np.random.randint(0, self._pos - L + 1, size=total)
+        starts = self.sample_starts(total, L)
         env_idxs = np.random.randint(0, self._n_envs, size=total)
         seq = (starts[:, None] + np.arange(L)[None, :]) % self._buffer_size  # [total, L]
         # flat (time, env) row indices in FINAL [n_samples, L, batch] order —

@@ -87,6 +87,35 @@ def test_dreamer_v3(standard_args, env_id):
     )
 
 
+def test_dreamer_v3_device_ring(standard_args):
+    """HBM-resident replay ring (buffer.device_cache=true forces it on the
+    CPU backend): the bench-critical path where batches gather on device."""
+    _run(
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo=dreamer_v3_XS",
+            "algo.per_rank_batch_size=2",
+            "algo.per_rank_sequence_length=2",
+            "algo.learning_starts=0",
+            "algo.horizon=4",
+            "algo.dense_units=16",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=16",
+            "algo.world_model.transition_model.hidden_size=16",
+            "algo.world_model.representation_model.hidden_size=16",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.stochastic_size=4",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.size=64",
+            "buffer.device_cache=true",
+        ],
+        standard_args,
+    )
+
+
 def test_dreamer_v3_decoupled_rssm(standard_args):
     """DecoupledRSSM variant: posterior computed from embeddings alone
     (reference agent.py:501-593, dreamer_v3.py:115-129)."""
