@@ -301,7 +301,14 @@ def main() -> None:
                 "this is a host-CPU measurement of the same end-to-end recipe"
             )
         if e2e_rec is not None:
+            if not cpu_fallback and pre is not None:
+                e2e_rec["platform"] = pre.get("platform")
+                e2e_rec["device"] = pre.get("device")
             if step_rec is not None:
+                # surface the chip-utilization figures on the headline record
+                for key in ("mfu", "model_flops_per_step", "peak_flops_assumed"):
+                    if key in step_rec:
+                        e2e_rec[key] = step_rec[key]
                 e2e_rec["extra_metrics"] = [step_rec]
             print(json.dumps(e2e_rec))
         elif step_rec is not None:

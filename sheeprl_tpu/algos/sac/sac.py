@@ -26,7 +26,8 @@ import numpy as np
 import optax
 
 from ...config import Config, instantiate
-from ...data import ReplayBuffer, StagedPrefetcher
+from ...data import ReplayBuffer
+from ...data.device_ring import estimate_row_bytes, make_uniform_prefetcher
 from ...parallel import Distributed
 from ...parallel.placement import make_param_mirror
 from ...utils.checkpoint import CheckpointManager
@@ -194,11 +195,15 @@ def main(dist: Distributed, cfg: Config) -> None:
     last_checkpoint = state["last_checkpoint"] if state else 0
     cumulative_grad_steps = state["cumulative_grad_steps"] if state else 0
 
-    def _host_sample(g):
-        s = rb.sample(batch_size * g, sample_next_obs=False, n_samples=1)
-        return {k: np.asarray(v).reshape(g, batch_size, *v.shape[2:]) for k, v in s.items()}
-
-    prefetch = StagedPrefetcher(_host_sample, dist.sharding(None, "dp"))  # [G, B, ...]
+    # [G, B, ...] batches: HBM ring on a single remote accelerator, else
+    # host-sampled + dp-sharded staging (data/device_ring.py)
+    prefetch = make_uniform_prefetcher(
+        cfg,
+        dist,
+        rb,
+        batch_size,
+        row_bytes_hint=estimate_row_bytes(obs_space, act_dim),
+    )
     pending_metrics: list = []
     # per-step inference on the player device (host CPU when the mesh is a
     # remote accelerator); mirror re-syncs the actor after each train burst

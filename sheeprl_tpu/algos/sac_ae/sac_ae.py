@@ -23,6 +23,7 @@ import optax
 
 from ...config import Config, instantiate
 from ...data import ReplayBuffer
+from ...data.device_ring import estimate_row_bytes, make_uniform_prefetcher
 from ...parallel import Distributed
 from ...parallel.placement import make_param_mirror
 from ...utils.checkpoint import CheckpointManager
@@ -255,6 +256,18 @@ def main(dist: Distributed, cfg: Config) -> None:
     last_log = state["last_log"] if state else 0
     last_checkpoint = state["last_checkpoint"] if state else 0
 
+    # [G, B, ...] pixel batches: HBM ring on a single remote accelerator
+    # (next_* frames are stored explicitly, hence the ×2 obs hint and the
+    # next_-prefixed cnn keys keeping uint8), else host sampling
+    prefetch = make_uniform_prefetcher(
+        cfg,
+        dist,
+        rb,
+        batch_size,
+        cnn_keys=cnn_keys + tuple(f"next_{k}" for k in cnn_keys),
+        row_bytes_hint=2 * estimate_row_bytes(obs_space, act_dim),
+    )
+
     # per-step inference on the player device (host CPU when the mesh is a
     # remote accelerator); mirror re-syncs encoder+actor after a train burst
     mirror, pdev, player_key, root_key = make_param_mirror(
@@ -322,18 +335,17 @@ def main(dist: Distributed, cfg: Config) -> None:
             g = ratio(policy_step / dist.world_size)
             if g > 0:
                 with timer("Time/train_time"):
-                    sample = rb.sample(batch_size * g)
-                    mb_sharding = dist.sharding(None, "dp")
-                    batches = {
-                        k: jax.device_put(np.asarray(v).reshape(g, batch_size, *v.shape[2:]), mb_sharding)
-                        for k, v in sample.items()
-                    }
+                    batches = prefetch.take(g)
                     root_key, sub = jax.random.split(root_key)
                     keys = jax.random.split(sub, g)
                     params, opt_states, metrics = train(params, opt_states, batches, keys)
                     mirror.refresh({"encoder": params["encoder"], "actor": params["actor"]})
                 for k, v in metrics.items():
                     aggregator.update(k, np.asarray(v))
+            if policy_step < total_steps:
+                # overlap the next sample (and its transfer/gather) with the
+                # train burst the device is computing right now
+                prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
 
         if rank == 0 and logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
             logger.log_metrics(aggregator.compute(), policy_step)

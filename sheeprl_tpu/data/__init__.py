@@ -6,7 +6,13 @@ from .buffers import (
 )
 from .memmap import MemmapArray
 from .prefetch import DevicePrefetcher, StagedPrefetcher
-from .device_ring import DeviceRingPrefetcher, estimate_row_bytes, make_sequential_prefetcher
+from .device_ring import (
+    DeviceRingPrefetcher,
+    DeviceUniformRingPrefetcher,
+    estimate_row_bytes,
+    make_sequential_prefetcher,
+    make_uniform_prefetcher,
+)
 
 __all__ = [
     "EnvIndependentReplayBuffer",
@@ -16,7 +22,9 @@ __all__ = [
     "MemmapArray",
     "DevicePrefetcher",
     "DeviceRingPrefetcher",
+    "DeviceUniformRingPrefetcher",
     "StagedPrefetcher",
     "estimate_row_bytes",
     "make_sequential_prefetcher",
+    "make_uniform_prefetcher",
 ]

@@ -169,9 +169,17 @@ class ReplayBuffer:
         (reference buffers.py:223-288)."""
         if batch_size <= 0 or n_samples <= 0:
             raise ValueError("batch_size and n_samples must be > 0")
+        total = batch_size * n_samples
+        idxs, env_idxs = self.sample_indices(total, sample_next_obs)
+        return self._gather(idxs, env_idxs, batch_size, n_samples, sample_next_obs, clone)
+
+    def sample_indices(
+        self, total: int, sample_next_obs: bool = False
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Draw `total` uniform (row, env) index pairs (the validity rules of
+        reference buffers.py:223-288, shared with the device-ring gather)."""
         if not self._full and self._pos == 0:
             raise ValueError("No data in the buffer, cannot sample")
-        total = batch_size * n_samples
         if self._full:
             valid = self._buffer_size
             if sample_next_obs:
@@ -187,7 +195,7 @@ class ReplayBuffer:
                 raise RuntimeError("Not enough data to sample next observations")
             idxs = np.random.randint(0, upper, size=total)
         env_idxs = np.random.randint(0, self._n_envs, size=total)
-        return self._gather(idxs, env_idxs, batch_size, n_samples, sample_next_obs, clone)
+        return idxs, env_idxs
 
     def _gather(
         self,

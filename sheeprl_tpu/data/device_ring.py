@@ -171,12 +171,17 @@ class DeviceRingPrefetcher:
         e_idx = np.zeros((padded,), dtype=np.int32)
         t_idx[:n] = [r for _, r in rows]
         e_idx[:n] = [e for e, _ in rows]
+        # one fancy-indexed copy per (env, key) — a resume backlog can be the
+        # whole buffer, where a per-row python loop would stall startup
+        by_env: Dict[int, List[int]] = {}
+        for i, (e, _) in enumerate(rows):
+            by_env.setdefault(e, []).append(i)
         data: Dict[str, np.ndarray] = {}
         for k in self._ring:
             item = self._rb.buffer[0][k].shape[2:]
             out = np.zeros((padded,) + item, dtype=self._rb.buffer[0][k].dtype)
-            for i, (e, r) in enumerate(rows):
-                out[i] = self._rb.buffer[e][k][r, 0]
+            for e, slots in by_env.items():
+                out[slots] = self._rb.buffer[e][k][t_idx[slots], 0]
             data[k] = out
         dev = self._device
         self._ring = _scatter_rows(
@@ -254,12 +259,169 @@ class DeviceRingPrefetcher:
         self._dirty_rows.clear()
 
 
-def _auto_enabled(cfg: Any, dist: Any, nbytes_estimate: int) -> bool:
+@functools.partial(jax.jit, donate_argnums=0)
+def _scatter_steps(ring: Dict[str, jax.Array], rows: Dict[str, jax.Array],
+                   t_idx: jax.Array) -> Dict[str, jax.Array]:
+    # one scatter row covers all envs of a time step; padding is OOB-dropped
+    return {k: ring[k].at[t_idx].set(rows[k], mode="drop") for k in ring}
+
+
+@functools.partial(jax.jit, static_argnames=("g", "batch", "next_keys", "f32_keys"))
+def _gather_uniform(ring: Dict[str, jax.Array], t_idx: jax.Array, e_idx: jax.Array,
+                    g: int, batch: int, next_keys: Tuple[str, ...],
+                    f32_keys: Tuple[str, ...]) -> Dict[str, jax.Array]:
+    size = next(iter(ring.values())).shape[0]
+    out = {k: ring[k][t_idx, e_idx].reshape((g, batch) + ring[k].shape[2:]) for k in ring}
+    nxt = (t_idx + 1) % size
+    for k in next_keys:
+        out[f"next_{k}"] = ring[k][nxt, e_idx].reshape((g, batch) + ring[k].shape[2:])
+    def _f32(k: str) -> bool:
+        return k in f32_keys or (k.startswith("next_") and k[5:] in f32_keys)
+
+    return {k: v.astype(jnp.float32) if _f32(k) else v for k, v in out.items()}
+
+
+class DeviceUniformRingPrefetcher:
+    """HBM mirror of a plain :class:`ReplayBuffer` serving uniform
+    ``[G, B, ...]`` batches (the SAC / SAC-AE / DroQ template). Same
+    once-over-the-link contract as :class:`DeviceRingPrefetcher`; rows are
+    shipped per time step (all envs at once — the buffer adds in lockstep)."""
+
+    def __init__(
+        self,
+        rb: Any,
+        batch_size: int,
+        cnn_keys: Sequence[str] = (),
+        sample_next_obs: bool = False,
+        device: Optional[Any] = None,
+        bucket: int = 64,
+    ):
+        self._rb = rb
+        self._batch = int(batch_size)
+        self._cnn_keys = tuple(cnn_keys)
+        self._next_obs = bool(sample_next_obs)
+        self._device = device if device is not None else jax.local_devices()[0]
+        self._bucket = int(bucket)
+        self._ring: Optional[Dict[str, jax.Array]] = None
+        self._synced_added = 0
+        self._staged: Optional[tuple] = None
+        self._last_idx: Optional[tuple] = None  # (t_idx, e_idx) — tests
+
+    @property
+    def ring(self) -> Optional[Dict[str, jax.Array]]:
+        return self._ring
+
+    def _ensure_ring(self) -> None:
+        if self._ring is not None:
+            return
+        b = self._rb
+        if b.empty:
+            raise ValueError("No data in the buffer, cannot mirror")
+        self._ring = {
+            k: jax.device_put(
+                jnp.zeros((b.buffer_size, b.n_envs) + b[k].shape[2:], dtype=b[k].dtype),
+                self._device,
+            )
+            for k in b.keys()
+        }
+
+    def sync(self) -> None:
+        b = self._rb
+        if b.empty:
+            return
+        self._ensure_ring()
+        size = b.buffer_size
+        delta = b._added - self._synced_added
+        if delta <= 0:
+            return
+        if delta >= size:
+            steps = [(b._pos + i) % size for i in range(size)] if b.full else list(range(b._pos))
+        else:
+            steps = [(b._pos - delta + i) % size for i in range(delta)]
+        self._synced_added = b._added
+        n = len(steps)
+        padded = -(-n // self._bucket) * self._bucket
+        t_idx = np.full((padded,), size, dtype=np.int32)
+        t_idx[:n] = steps
+        dev = self._device
+        data = {}
+        for k in self._ring:
+            host = b[k]
+            out = np.zeros((padded,) + host.shape[1:], dtype=host.dtype)
+            out[:n] = host[steps]
+            data[k] = jax.device_put(out, dev)
+        self._ring = _scatter_steps(self._ring, data, jax.device_put(t_idx, dev))
+
+    def _f32_keys(self) -> Tuple[str, ...]:
+        b = self._rb
+        return tuple(k for k in b.keys() if k not in self._cnn_keys and b[k].dtype != np.float32)
+
+    def _gather(self, g: int) -> Any:
+        self.sync()
+        idxs, env_idxs = self._rb.sample_indices(self._batch * g, self._next_obs)
+        self._last_idx = (idxs, env_idxs)
+        next_keys = tuple(k for k in self._rb._obs_keys if k in self._rb.keys()) if self._next_obs else ()
+        dev = self._device
+        return _gather_uniform(
+            self._ring,
+            jax.device_put(idxs.astype(np.int32), dev),
+            jax.device_put(env_idxs.astype(np.int32), dev),
+            g,
+            self._batch,
+            next_keys,
+            self._f32_keys(),
+        )
+
+    def stage(self, g: int) -> None:
+        if g <= 0:
+            self._staged = None
+            return
+        try:
+            self._staged = (g, self._gather(g))
+        except (ValueError, RuntimeError):
+            self._staged = None
+
+    def take(self, g: int) -> Any:
+        staged, self._staged = self._staged, None
+        if staged is not None and staged[0] == g:
+            return staged[1]
+        return self._gather(g)
+
+    def resync(self) -> None:
+        self._ring = None
+        self._synced_added = 0
+        self._staged = None
+
+
+def _ring_mode(cfg: Any) -> str:
+    """Parse buffer.device_cache: YAML booleans arrive as real bools, so
+    `device_cache: false` must force the ring OFF, not fall through an
+    `or "auto"` truthiness hole."""
+    raw = cfg.select("buffer.device_cache", "auto")
+    mode = "auto" if raw is None else str(raw).lower()
+    if mode not in ("auto", "true", "false"):
+        raise ValueError(f"buffer.device_cache must be auto|true|false, got '{raw}'")
+    return mode
+
+
+def _use_ring(cfg: Any, dist: Any, row_bytes_hint: Optional[int], rb_rows: int) -> bool:
+    mode = _ring_mode(cfg)
+    if mode == "false":
+        return False
+    if mode == "true":
+        if dist.world_size > 1:
+            raise ValueError(
+                "buffer.device_cache=true requires a single-device mesh "
+                f"(got {dist.world_size} devices); use auto or false"
+            )
+        return True
     cap = int(cfg.select("buffer.device_cache_max_bytes", 6_000_000_000) or 0)
     return (
         dist.world_size == 1
-        and jax.local_devices()[0].platform != "cpu"
-        and nbytes_estimate <= cap
+        # the MESH device decides, not whatever backend the host also has:
+        # a cpu-forced run on an accelerator machine must not build a ring
+        and getattr(dist.local_device, "platform", "cpu") != "cpu"
+        and (row_bytes_hint or 0) * rb_rows <= cap
     )
 
 
@@ -291,22 +453,10 @@ def make_sequential_prefetcher(
     buffer fits ``buffer.device_cache_max_bytes`` (the remote-link case it
     was built for; on multi-device meshes batches stay host-sampled and
     dp-sharded by StagedPrefetcher)."""
-    raw = cfg.select("buffer.device_cache", "auto")
-    # YAML booleans arrive as real bools: `device_cache: false` must force
-    # the ring OFF, not fall through an `or "auto"` truthiness hole
-    mode = "auto" if raw is None else str(raw).lower()
-    if mode not in ("auto", "true", "false"):
-        raise ValueError(f"buffer.device_cache must be auto|true|false, got '{mode}'")
-    use_ring = False
-    if isinstance(rb, EnvIndependentReplayBuffer) and all(
+    supported = isinstance(rb, EnvIndependentReplayBuffer) and all(
         isinstance(b, SequentialReplayBuffer) for b in rb.buffer
-    ):
-        if mode == "true":
-            use_ring = True
-        elif mode == "auto":
-            est = (row_bytes_hint or 0) * rb.buffer_size * rb.n_envs
-            use_ring = _auto_enabled(cfg, dist, est)
-    if use_ring:
+    )
+    if supported and _use_ring(cfg, dist, row_bytes_hint, rb.buffer_size * rb.n_envs):
         return DeviceRingPrefetcher(
             rb, batch_size, sequence_length, cnn_keys=cnn_keys, device=dist.local_device
         )
@@ -318,3 +468,34 @@ def make_sequential_prefetcher(
                 for k, v in s.items()
             }
     return StagedPrefetcher(host_sample_fn, dist.sharding(None, None, "dp"))
+
+
+def make_uniform_prefetcher(
+    cfg: Any,
+    dist: Any,
+    rb: Any,
+    batch_size: int,
+    cnn_keys: Sequence[str] = (),
+    sample_next_obs: bool = False,
+    host_sample_fn: Optional[Any] = None,
+    row_bytes_hint: Optional[int] = None,
+):
+    """Prefetcher for the uniform-replay (SAC-family) train loops: the HBM
+    ring under the same ``buffer.device_cache`` policy as the sequential
+    path, else host sampling staged one burst ahead ([G, B, ...] batches)."""
+    if _use_ring(cfg, dist, row_bytes_hint, rb.buffer_size * rb.n_envs):
+        return DeviceUniformRingPrefetcher(
+            rb,
+            batch_size,
+            cnn_keys=cnn_keys,
+            sample_next_obs=sample_next_obs,
+            device=dist.local_device,
+        )
+    if host_sample_fn is None:
+        def host_sample_fn(g):  # noqa: F811 — default uniform host sample
+            s = rb.sample(batch_size * g, sample_next_obs=sample_next_obs, n_samples=1)
+            return {
+                k: np.asarray(v).reshape(g, batch_size, *np.asarray(v).shape[2:])
+                for k, v in s.items()
+            }
+    return StagedPrefetcher(host_sample_fn, dist.sharding(None, "dp"))

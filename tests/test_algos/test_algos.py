@@ -37,7 +37,8 @@ def test_ppo(standard_args, env_id):
     )
 
 
-def test_sac(standard_args):
+@pytest.mark.parametrize("device_cache", ["auto", "true"])
+def test_sac(standard_args, device_cache):
     _run(
         [
             "exp=sac",
@@ -48,6 +49,7 @@ def test_sac(standard_args):
             "algo.learning_starts=0",
             "algo.mlp_keys.encoder=[state]",
             "buffer.size=64",
+            f"buffer.device_cache={device_cache}",  # true forces the HBM ring
         ],
         standard_args,
     )

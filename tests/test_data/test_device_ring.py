@@ -181,3 +181,68 @@ def test_rejects_non_sequential_subbuffers():
     rb = EnvIndependentReplayBuffer(8, n_envs=1, obs_keys=KEYS, buffer_cls=ReplayBuffer)
     with pytest.raises(TypeError):
         DeviceRingPrefetcher(rb, 2, 2)
+
+
+# -- uniform ([G, B, ...]) ring: the SAC-family path -----------------------
+
+def _uniform_make(size=32, n_envs=2, batch=4, **kw):
+    from sheeprl_tpu.data import ReplayBuffer
+    from sheeprl_tpu.data.device_ring import DeviceUniformRingPrefetcher
+
+    rb = ReplayBuffer(size, n_envs=n_envs, obs_keys=KEYS)
+    ring = DeviceUniformRingPrefetcher(rb, batch, cnn_keys=("rgb",), bucket=8, **kw)
+    return rb, ring
+
+
+def test_uniform_gather_matches_host():
+    rb, ring = _uniform_make()
+    for t in range(12):
+        rb.add(_row(t, 0, 2))
+    batch = ring.take(3)
+    idxs, env_idxs = ring._last_idx
+    assert batch["state"].shape == (3, 4, 3)
+    got = np.asarray(batch["state"]).reshape(12, 3)
+    expect = rb["state"][idxs, env_idxs]
+    np.testing.assert_array_equal(got, expect)
+    assert batch["rgb"].dtype == np.uint8
+
+
+def test_uniform_next_obs_parity():
+    rb, ring = _uniform_make(sample_next_obs=True)
+    for t in range(12):
+        rb.add(_row(t, 0, 2))
+    batch = ring.take(2)
+    idxs, env_idxs = ring._last_idx
+    assert "next_state" in batch and "next_rgb" in batch
+    got = np.asarray(batch["next_state"]).reshape(8, 3)
+    expect = rb["state"][(idxs + 1) % rb.buffer_size, env_idxs]
+    np.testing.assert_array_equal(got, expect)
+    # next_<cnn key> keeps its stored dtype
+    assert batch["next_rgb"].dtype == np.uint8
+
+
+def test_forced_ring_rejects_multidevice_mesh():
+    from sheeprl_tpu.data.device_ring import _use_ring
+
+    class _Cfg:
+        def select(self, path, default=None):
+            return {"buffer.device_cache": "true"}.get(path, default)
+
+    class _Dist:
+        world_size = 2
+        local_device = None
+
+    with pytest.raises(ValueError, match="single-device mesh"):
+        _use_ring(_Cfg(), _Dist(), 100, 10)
+
+
+def test_uniform_wraparound_and_backlog():
+    rb, ring = _uniform_make(size=16)
+    rb.add(_row(0, 0, 2))
+    ring.sync()
+    for t in range(1, 40):
+        rb.add(_row(t, 0, 2))
+    ring.sync()
+    ring_host = {k: np.asarray(v) for k, v in ring.ring.items()}
+    np.testing.assert_array_equal(ring_host["state"], rb["state"])
+    np.testing.assert_array_equal(ring_host["rgb"], rb["rgb"])
