@@ -81,7 +81,7 @@ class DeviceRingPrefetcher:
         sequence_length: int,
         cnn_keys: Sequence[str] = (),
         device: Optional[Any] = None,
-        bucket: int = 64,
+        bucket: int = 8,
     ):
         for b in rb.buffer:
             if not isinstance(b, SequentialReplayBuffer):
@@ -294,7 +294,7 @@ class DeviceUniformRingPrefetcher:
         cnn_keys: Sequence[str] = (),
         sample_next_obs: bool = False,
         device: Optional[Any] = None,
-        bucket: int = 64,
+        bucket: int = 8,
     ):
         self._rb = rb
         self._batch = int(batch_size)
