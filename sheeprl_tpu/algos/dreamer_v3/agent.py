@@ -37,6 +37,11 @@ from ...distributions import (
 from ...config.instantiate import locate
 from ...models import MLP, LayerNorm, LayerNormGRUCell
 from ...ops import symlog
+from ...ops.conv_einsum import (
+    EinsumConv4x4S2,
+    EinsumConvTranspose4x4S2,
+    resolve_conv_impl,
+)
 
 xavier_normal = nn.initializers.xavier_normal()
 
@@ -79,22 +84,34 @@ class DV3CNNEncoder(nn.Module):
     channels_multiplier: int
     stages: int = 4
     layer_norm: bool = True
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        einsum_convs = resolve_conv_impl(self.conv_impl)
         x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
         lead = x.shape[:-3]
         x = x.reshape((-1,) + x.shape[-3:])
         for i in range(self.stages):
-            x = nn.Conv(
-                (2**i) * self.channels_multiplier,
-                (4, 4),
-                strides=(2, 2),
-                padding=((1, 1), (1, 1)),
-                use_bias=not self.layer_norm,
-                kernel_init=xavier_normal,
-                name=f"conv_{i}",
-            )(x)
+            if einsum_convs:
+                conv = EinsumConv4x4S2(
+                    (2**i) * self.channels_multiplier,
+                    padding=((1, 1), (1, 1)),
+                    use_bias=not self.layer_norm,
+                    kernel_init=xavier_normal,
+                    name=f"conv_{i}",
+                )
+            else:
+                conv = nn.Conv(
+                    (2**i) * self.channels_multiplier,
+                    (4, 4),
+                    strides=(2, 2),
+                    padding=((1, 1), (1, 1)),
+                    use_bias=not self.layer_norm,
+                    kernel_init=xavier_normal,
+                    name=f"conv_{i}",
+                )
+            x = conv(x)
             if self.layer_norm:
                 x = LayerNorm(eps=1e-3)(x)
             x = nn.silu(x)
@@ -131,12 +148,17 @@ class DV3Encoder(nn.Module):
     mlp_layers: int = 5
     dense_units: int = 1024
     layer_norm: bool = True
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
         feats = []
         if self.cnn_keys:
-            feats.append(DV3CNNEncoder(self.cnn_keys, self.cnn_channels_multiplier)(obs))
+            feats.append(
+                DV3CNNEncoder(
+                    self.cnn_keys, self.cnn_channels_multiplier, conv_impl=self.conv_impl
+                )(obs)
+            )
         if self.mlp_keys:
             feats.append(
                 DV3MLPEncoder(self.mlp_keys, self.mlp_layers, self.dense_units, self.layer_norm)(obs)
@@ -151,9 +173,11 @@ class DV3CNNDecoder(nn.Module):
     image_size: Tuple[int, int] = (64, 64)
     stages: int = 4
     layer_norm: bool = True
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        einsum_convs = resolve_conv_impl(self.conv_impl)
         start = self.image_size[0] // (2**self.stages)
         c0 = (2 ** (self.stages - 1)) * self.channels_multiplier
         lead = latent.shape[:-1]
@@ -161,28 +185,43 @@ class DV3CNNDecoder(nn.Module):
         x = x.reshape((-1, start, start, c0))
         for i in range(self.stages - 1):
             ch = (2 ** (self.stages - i - 2)) * self.channels_multiplier
-            x = nn.ConvTranspose(
-                ch,
-                (4, 4),
-                strides=(2, 2),
-                padding=((2, 2), (2, 2)),  # torch k4 s2 p1 ≡ flax pad k-1-p=2
-                use_bias=not self.layer_norm,
-                transpose_kernel=True,
-                kernel_init=xavier_normal,
-                name=f"deconv_{i}",
-            )(x)
+            if einsum_convs:
+                deconv = EinsumConvTranspose4x4S2(
+                    ch,
+                    use_bias=not self.layer_norm,
+                    kernel_init=xavier_normal,
+                    name=f"deconv_{i}",
+                )
+            else:
+                deconv = nn.ConvTranspose(
+                    ch,
+                    (4, 4),
+                    strides=(2, 2),
+                    padding=((2, 2), (2, 2)),  # torch k4 s2 p1 ≡ flax pad k-1-p=2
+                    use_bias=not self.layer_norm,
+                    transpose_kernel=True,
+                    kernel_init=xavier_normal,
+                    name=f"deconv_{i}",
+                )
+            x = deconv(x)
             if self.layer_norm:
                 x = LayerNorm(eps=1e-3)(x)
             x = nn.silu(x)
-        x = nn.ConvTranspose(
-            sum(self.output_channels),
-            (4, 4),
-            strides=(2, 2),
-            padding=((2, 2), (2, 2)),
-            transpose_kernel=True,
-            kernel_init=uniform_init(1.0),
-            name="to_obs",
-        )(x)
+        if einsum_convs:
+            to_obs = EinsumConvTranspose4x4S2(
+                sum(self.output_channels), kernel_init=uniform_init(1.0), name="to_obs"
+            )
+        else:
+            to_obs = nn.ConvTranspose(
+                sum(self.output_channels),
+                (4, 4),
+                strides=(2, 2),
+                padding=((2, 2), (2, 2)),
+                transpose_kernel=True,
+                kernel_init=uniform_init(1.0),
+                name="to_obs",
+            )
+        x = to_obs(x)
         x = x.reshape(lead + x.shape[1:])
         out: Dict[str, jax.Array] = {}
         start_ch = 0
@@ -225,6 +264,7 @@ class DV3Decoder(nn.Module):
     mlp_layers: int = 5
     dense_units: int = 1024
     layer_norm: bool = True
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
@@ -232,7 +272,11 @@ class DV3Decoder(nn.Module):
         if self.cnn_keys:
             out.update(
                 DV3CNNDecoder(
-                    self.cnn_keys, self.cnn_output_channels, self.cnn_channels_multiplier, self.image_size
+                    self.cnn_keys,
+                    self.cnn_output_channels,
+                    self.cnn_channels_multiplier,
+                    self.image_size,
+                    conv_impl=self.conv_impl,
                 )(latent)
             )
         if self.mlp_keys:
@@ -477,6 +521,7 @@ class WorldModel(nn.Module):
     reward_dense_units: Optional[int] = None
     continue_mlp_layers: Optional[int] = None
     continue_dense_units: Optional[int] = None
+    conv_impl: str = "auto"
 
     def setup(self) -> None:
         self.encoder = DV3Encoder(
@@ -485,6 +530,7 @@ class WorldModel(nn.Module):
             cnn_channels_multiplier=self.cnn_channels_multiplier,
             mlp_layers=self.encoder_mlp_layers or self.mlp_layers,
             dense_units=self.encoder_dense_units or self.dense_units,
+            conv_impl=self.conv_impl,
         )
         self.rssm = RSSM(
             stochastic_size=self.stochastic_size,
@@ -506,6 +552,7 @@ class WorldModel(nn.Module):
             image_size=self.image_size,
             mlp_layers=self.decoder_mlp_layers or self.mlp_layers,
             dense_units=self.decoder_dense_units or self.dense_units,
+            conv_impl=self.conv_impl,
         )
         self.reward_model = DV3Head(
             self.reward_bins,
@@ -753,6 +800,7 @@ def build_agent(
         reward_bins=int(wm_cfg.reward_model.bins),
         learnable_initial_recurrent_state=bool(wm_cfg.learnable_initial_recurrent_state),
         decoupled_rssm=bool(wm_cfg.select("decoupled_rssm") or False),
+        conv_impl=str(wm_cfg.select("conv_impl", "auto")),
         representation_hidden_size=int(wm_cfg.representation_model.hidden_size),
         recurrent_dense_units=int(wm_cfg.recurrent_model.dense_units),
         decoder_cnn_channels_multiplier=int(wm_cfg.observation_model.cnn_channels_multiplier),

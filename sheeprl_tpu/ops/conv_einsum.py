@@ -1,0 +1,159 @@
+"""Space-to-depth einsum lowering for the Dreamer 4x4/stride-2 convolutions.
+
+XLA's CPU backend picks pathological kernels for the *gradient* convolutions
+of tiny-channel stages inside large programs (the 3->C first encoder conv and
+the C->3 final decoder deconv: ~1.9 s each per DV3 tiny-bench gradient step,
+~40x their standalone cost, profiled via jax.profiler on the round-4 box).
+Lowering the k=4 s=2 convs to space-to-depth + `dot_general` removes every
+`conv_general_dilated` from the program: forward AND autodiff-generated
+backward become plain GEMMs + reshapes, which every backend handles
+layout-robustly.
+
+Forward (conv, stride 2, kernel 4): pad the input so the padded height/width
+are even, view it as a grid of 2x2 blocks with 4C channels (a pure
+reshape/transpose), and the conv becomes a 2x2-tap stride-1 window over
+blocks: four shifted block-slices, each contracted with a [4C, C_out] slice
+of the rearranged kernel.
+
+Transposed conv (k=4, s=2, torch padding 1 — the DV3 decoder shape): output
+pixel 2m+r on each axis receives exactly two kernel taps; per output phase
+r in {0,1}: out[2m]   = K'[0] x[m-1] + K'[2] x[m]
+            out[2m+1] = K'[1] x[m]   + K'[3] x[m+1]
+(K' = spatially flipped kernel, the lax.conv_transpose(transpose_kernel=True)
+convention — parity verified exactly against flax nn.ConvTranspose). The
+four (phase_h, phase_w) outputs are computed together by contracting 3x3
+shifted slices of the once-padded input with a combined [C_in, 4*C_out]
+kernel, then interleaved with one reshape/transpose (depth-to-space).
+
+`EinsumConv4x4S2` / `EinsumConvTranspose4x4S2` declare parameters with the
+same names, shapes and initializers as `nn.Conv` / `nn.ConvTranspose`
+(transpose_kernel=True), so checkpoints are interchangeable between the two
+implementations and `conv_impl` can be flipped on an existing run.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Padding = Tuple[Tuple[int, int], Tuple[int, int]]
+
+
+def resolve_conv_impl(impl: str) -> bool:
+    """True -> use the einsum lowering. "auto" picks it on the CPU backend
+    (where the XLA conv gradients are pathological) and keeps native convs on
+    TPU/GPU (the MXU conv path is already optimal there)."""
+    if impl == "einsum":
+        return True
+    if impl == "xla":
+        return False
+    if impl == "auto":
+        return jax.default_backend() == "cpu"
+    raise ValueError(f"conv_impl must be one of auto|einsum|xla, got {impl!r}")
+
+
+def conv2d_k4s2(x: jax.Array, kernel: jax.Array, padding: Padding) -> jax.Array:
+    """NHWC conv, kernel [4, 4, C_in, C_out] (nn.Conv layout), stride 2.
+
+    Requires (H + pad_top + pad_bottom) and (W + pad_left + pad_right) even —
+    true for every Dreamer stage (64/32/16/8 with pad 1+1 or VALID).
+    """
+    kh, kw, cin, cout = kernel.shape
+    assert (kh, kw) == (4, 4), (kh, kw)
+    (pt, pb), (pl, pr) = padding
+    xp = jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+    n, hp, wp = xp.shape[0], xp.shape[1], xp.shape[2]
+    if hp % 2 or wp % 2:
+        raise ValueError(f"padded spatial dims must be even, got {(hp, wp)}")
+    a, b = hp // 2, wp // 2
+    # space-to-depth: [N, A, B, (dr, dc, C)]
+    xsd = xp.reshape(n, a, 2, b, 2, cin).transpose(0, 1, 3, 2, 4, 5).reshape(n, a, b, 4 * cin)
+    # kernel [4,4,C,CO] -> [(block_h, dr), (block_w, dc), C, CO] -> [2, 2, 4C, CO]
+    ksd = (
+        kernel.reshape(2, 2, 2, 2, cin, cout)
+        .transpose(0, 2, 1, 3, 4, 5)
+        .reshape(2, 2, 4 * cin, cout)
+    )
+    ho, wo = a - 1, b - 1
+    y = None
+    for u in range(2):
+        for v in range(2):
+            t = jnp.einsum("nhwc,cd->nhwd", xsd[:, u : u + ho, v : v + wo, :], ksd[u, v])
+            y = t if y is None else y + t
+    return y
+
+
+# transposed conv, phase r taps: {slice offset u (into pad-1 input): kernel tap}
+_TR_TAPS = ({0: 0, 1: 2}, {1: 1, 2: 3})
+
+
+def conv_transpose2d_k4s2p1(x: jax.Array, kernel: jax.Array) -> jax.Array:
+    """NHWC transposed conv, kernel [4, 4, C_out, C_in] (nn.ConvTranspose
+    transpose_kernel=True layout), stride 2, torch padding 1 (flax explicit
+    padding ((2,2),(2,2))). Output spatial dims are exactly 2x the input's."""
+    kh, kw, cout, cin = kernel.shape
+    assert (kh, kw) == (4, 4), (kh, kw)
+    w = jnp.transpose(kernel[::-1, ::-1], (0, 1, 3, 2))  # flip + [4,4,CI,CO]
+    n, ih, iw = x.shape[0], x.shape[1], x.shape[2]
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    y = None
+    for u in range(3):
+        for v in range(3):
+            blocks = []
+            for rh in range(2):
+                for rw in range(2):
+                    dh = _TR_TAPS[rh].get(u)
+                    dw = _TR_TAPS[rw].get(v)
+                    if dh is None or dw is None:
+                        blocks.append(jnp.zeros((cin, cout), w.dtype))
+                    else:
+                        blocks.append(w[dh, dw])
+            wc = jnp.stack(blocks, axis=1).reshape(cin, 4 * cout)
+            t = jnp.einsum("nhwc,cd->nhwd", xp[:, u : u + ih, v : v + iw, :], wc)
+            y = t if y is None else y + t
+    # depth-to-space: [N, I, I, (rh, rw, CO)] -> [N, 2I, 2I, CO]
+    return (
+        y.reshape(n, ih, iw, 2, 2, cout)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(n, 2 * ih, 2 * iw, cout)
+    )
+
+
+class EinsumConv4x4S2(nn.Module):
+    """Drop-in for ``nn.Conv(features, (4, 4), strides=(2, 2), padding=...)``
+    with an identical parameter tree (kernel [4,4,C_in,features], bias)."""
+
+    features: int
+    padding: Padding
+    use_bias: bool = True
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param("kernel", self.kernel_init, (4, 4, x.shape[-1], self.features))
+        y = conv2d_k4s2(x, kernel, self.padding)
+        if self.use_bias:
+            y = y + self.param("bias", self.bias_init, (self.features,))
+        return y
+
+
+class EinsumConvTranspose4x4S2(nn.Module):
+    """Drop-in for ``nn.ConvTranspose(features, (4, 4), strides=(2, 2),
+    padding=((2, 2), (2, 2)), transpose_kernel=True)`` with an identical
+    parameter tree (kernel [4,4,features,C_in], bias)."""
+
+    features: int
+    use_bias: bool = True
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param("kernel", self.kernel_init, (4, 4, self.features, x.shape[-1]))
+        y = conv_transpose2d_k4s2p1(x, kernel)
+        if self.use_bias:
+            y = y + self.param("bias", self.bias_init, (self.features,))
+        return y

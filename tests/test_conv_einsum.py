@@ -1,0 +1,93 @@
+"""Parity of the space-to-depth einsum conv lowering (ops/conv_einsum.py)
+against the native XLA convolutions it replaces on the CPU backend."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+from jax import lax
+
+from sheeprl_tpu.algos.dreamer_v3.agent import DV3CNNDecoder, DV3CNNEncoder
+from sheeprl_tpu.ops.conv_einsum import (
+    conv2d_k4s2,
+    conv_transpose2d_k4s2p1,
+    resolve_conv_impl,
+)
+
+DN = ("NHWC", "HWIO", "NHWC")
+
+
+@pytest.mark.parametrize("padding", [((1, 1), (1, 1)), ((0, 0), (0, 0))])
+def test_conv2d_k4s2_matches_native(padding):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 16, 16, 3)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((4, 4, 3, 5)), jnp.float32) * 0.1
+    ref = lax.conv_general_dilated(x, w, (2, 2), padding, dimension_numbers=DN)
+    got = conv2d_k4s2(x, w, padding)
+    assert ref.shape == got.shape
+    np.testing.assert_allclose(ref, got, atol=1e-5)
+
+    g_ref = jax.grad(
+        lambda w, x: ((lax.conv_general_dilated(x, w, (2, 2), padding, dimension_numbers=DN)) ** 2).sum(),
+        argnums=(0, 1),
+    )(w, x)
+    g_got = jax.grad(lambda w, x: ((conv2d_k4s2(x, w, padding)) ** 2).sum(), argnums=(0, 1))(w, x)
+    for r, g in zip(g_ref, g_got):
+        np.testing.assert_allclose(r, g, rtol=1e-4, atol=1e-3)
+
+
+def test_conv_transpose2d_matches_flax():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((3, 7, 7, 4)), jnp.float32)
+    mod = nn.ConvTranspose(
+        6, (4, 4), strides=(2, 2), padding=((2, 2), (2, 2)), transpose_kernel=True, use_bias=False
+    )
+    params = mod.init(jax.random.key(0), x)
+    ref = mod.apply(params, x)
+    got = conv_transpose2d_k4s2p1(x, params["params"]["kernel"])
+    assert ref.shape == got.shape == (3, 14, 14, 6)
+    np.testing.assert_allclose(ref, got, atol=1e-5)
+
+    k = params["params"]["kernel"]
+    g_ref = jax.grad(lambda k, x: (mod.apply({"params": {"kernel": k}}, x) ** 2).sum(), argnums=(0, 1))(k, x)
+    g_got = jax.grad(lambda k, x: (conv_transpose2d_k4s2p1(x, k) ** 2).sum(), argnums=(0, 1))(k, x)
+    for r, g in zip(g_ref, g_got):
+        np.testing.assert_allclose(r, g, rtol=1e-4, atol=1e-3)
+
+
+@pytest.mark.parametrize("module,make_input", [
+    (
+        lambda impl: DV3CNNEncoder(keys=("rgb",), channels_multiplier=4, conv_impl=impl),
+        lambda rng: {"rgb": jnp.asarray(rng.standard_normal((2, 3, 64, 64, 3)), jnp.float32)},
+    ),
+    (
+        lambda impl: DV3CNNDecoder(
+            keys=("rgb",), output_channels=(3,), channels_multiplier=4, conv_impl=impl
+        ),
+        lambda rng: jnp.asarray(rng.standard_normal((2, 3, 48)), jnp.float32),
+    ),
+])
+def test_dv3_modules_param_compatible_across_impls(module, make_input):
+    """Same param tree and (numerically) same outputs whichever lowering is
+    selected — checkpoints are interchangeable."""
+    rng = np.random.default_rng(2)
+    x = make_input(rng)
+    m_xla, m_ein = module("xla"), module("einsum")
+    p_xla = m_xla.init(jax.random.key(0), x)
+    p_ein = m_ein.init(jax.random.key(0), x)
+    assert jax.tree.structure(p_xla) == jax.tree.structure(p_ein)
+    for a, b in zip(jax.tree.leaves(p_xla), jax.tree.leaves(p_ein)):
+        assert a.shape == b.shape
+    out_x = m_xla.apply(p_xla, x)
+    out_e = m_ein.apply(p_xla, x)  # einsum path consumes the xla-init params
+    a, b = jax.tree.leaves(out_x), jax.tree.leaves(out_e)
+    for r, g in zip(a, b):
+        np.testing.assert_allclose(r, g, rtol=1e-4, atol=1e-4)
+
+
+def test_resolve_conv_impl():
+    assert resolve_conv_impl("einsum") is True
+    assert resolve_conv_impl("xla") is False
+    assert resolve_conv_impl("auto") == (jax.default_backend() == "cpu")
+    with pytest.raises(ValueError):
+        resolve_conv_impl("nope")
