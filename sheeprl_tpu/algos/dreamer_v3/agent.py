@@ -40,6 +40,7 @@ from ...ops import symlog
 from ...ops.conv_einsum import (
     EinsumConv4x4S2,
     EinsumConvTranspose4x4S2,
+    phase_split_nhwc,
     resolve_conv_impl,
 )
 
@@ -176,7 +177,11 @@ class DV3CNNDecoder(nn.Module):
     conv_impl: str = "auto"
 
     @nn.compact
-    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+    def __call__(self, latent: jax.Array, cnn_phases: bool = False) -> Dict[str, jax.Array]:
+        """``cnn_phases=True`` (training loss only): the final deconv output
+        stays in phase space [..., I, I, 2, 2, C] — see
+        ops/conv_einsum.py:conv_transpose2d_k4s2p1. Per-key channel slicing
+        is unchanged (channels are the trailing axis either way)."""
         einsum_convs = resolve_conv_impl(self.conv_impl)
         start = self.image_size[0] // (2**self.stages)
         c0 = (2 ** (self.stages - 1)) * self.channels_multiplier
@@ -208,11 +213,11 @@ class DV3CNNDecoder(nn.Module):
                 x = LayerNorm(eps=1e-3)(x)
             x = nn.silu(x)
         if einsum_convs:
-            to_obs = EinsumConvTranspose4x4S2(
+            x = EinsumConvTranspose4x4S2(
                 sum(self.output_channels), kernel_init=uniform_init(1.0), name="to_obs"
-            )
+            )(x, phases=cnn_phases)
         else:
-            to_obs = nn.ConvTranspose(
+            x = nn.ConvTranspose(
                 sum(self.output_channels),
                 (4, 4),
                 strides=(2, 2),
@@ -220,8 +225,9 @@ class DV3CNNDecoder(nn.Module):
                 transpose_kernel=True,
                 kernel_init=uniform_init(1.0),
                 name="to_obs",
-            )
-        x = to_obs(x)
+            )(x)
+            if cnn_phases:
+                x = phase_split_nhwc(x)
         x = x.reshape(lead + x.shape[1:])
         out: Dict[str, jax.Array] = {}
         start_ch = 0
@@ -267,7 +273,7 @@ class DV3Decoder(nn.Module):
     conv_impl: str = "auto"
 
     @nn.compact
-    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+    def __call__(self, latent: jax.Array, cnn_phases: bool = False) -> Dict[str, jax.Array]:
         out: Dict[str, jax.Array] = {}
         if self.cnn_keys:
             out.update(
@@ -277,7 +283,7 @@ class DV3Decoder(nn.Module):
                     self.cnn_channels_multiplier,
                     self.image_size,
                     conv_impl=self.conv_impl,
-                )(latent)
+                )(latent, cnn_phases=cnn_phases)
             )
         if self.mlp_keys:
             out.update(
@@ -602,6 +608,13 @@ class WorldModel(nn.Module):
 
     def decode(self, latent: jax.Array) -> Dict[str, jax.Array]:
         return self.observation_model(latent)
+
+    def decode_phases(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        """Training-loss decode: cnn outputs in phase space ([..., I, I, 2,
+        2, C]); the MSE against a `phase_split_nhwc` target sums to exactly
+        the pixel-space observation loss, without the depth-to-space
+        interleave (and, crucially, without its backward transpose)."""
+        return self.observation_model(latent, cnn_phases=True)
 
     def reward(self, latent: jax.Array) -> jax.Array:
         return self.reward_model(latent)

@@ -35,9 +35,7 @@ from ...data.device_ring import estimate_row_bytes, make_sequential_prefetcher
 from ...distributions import (
     BernoulliSafeMode,
     Independent,
-    MSEDistribution,
     OneHotCategoricalStraightThrough,
-    SymlogDistribution,
     TwoHotEncodingDistribution,
 )
 from ...ops import lambda_values as lambda_values_op
@@ -58,6 +56,7 @@ from .loss import reconstruction_loss
 from .utils import (
     AGGREGATOR_KEYS,
     MomentsState,
+    decode_obs_dists,
     extract_masks,
     init_moments,
     make_precision_applies,
@@ -65,6 +64,7 @@ from .utils import (
     prepare_obs,
     test,
     update_moments,
+    use_phase_obs_loss,
 )
 
 
@@ -131,6 +131,8 @@ def make_train_fn(
             file=sys.stderr,
         )
     pallas_interpret = pallas_mode == "interpret" or jax.default_backend() != "tpu"
+    # phase-space observation loss rides the einsum decoder (see decode_phases)
+    phase_obs_loss = use_phase_obs_loss(wm_cfg, cnn_keys)
     horizon = int(cfg.algo.horizon)
     gamma = float(cfg.algo.gamma)
     lmbda = float(cfg.algo.lmbda)
@@ -222,9 +224,9 @@ def make_train_fn(
                     dyn_step, (h0, z0), (batch_actions, embedded, is_first, keys)
                 )
             latents = jnp.concatenate([zs, hs], axis=-1)
-            recon = wm_apply(wm_params, WorldModel.decode, latents)
-            po = {k: MSEDistribution(recon[k], dims=3) for k in cnn_keys}
-            po.update({k: SymlogDistribution(recon[k], dims=1) for k in mlp_keys})
+            po, obs_targets = decode_obs_dists(
+                wm_apply, wm_params, WorldModel, latents, batch_obs, cnn_keys, mlp_keys, phase_obs_loss
+            )
             pr = TwoHotEncodingDistribution(wm_apply(wm_params, WorldModel.reward, latents), dims=1)
             pc = Independent(
                 BernoulliSafeMode(logits=wm_apply(wm_params, WorldModel.cont, latents)), 1
@@ -233,7 +235,7 @@ def make_train_fn(
             S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
             rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
                 po,
-                batch_obs,
+                obs_targets,
                 pr,
                 batch["rewards"],
                 prior_logits.reshape(T, B, S, D),

@@ -78,6 +78,38 @@ def normalize_obs(obs: Dict[str, jax.Array], cnn_keys) -> Dict[str, jax.Array]:
     return {k: (v.astype(jnp.float32) / 255.0 - 0.5) if k in cnn_keys else v for k, v in obs.items()}
 
 
+def use_phase_obs_loss(wm_cfg: Any, cnn_keys) -> bool:
+    """True when the observation MSE should be evaluated in phase space:
+    the einsum conv lowering is active (ops/conv_einsum.py) and there are
+    image keys to decode. Shared by the DV3 and P2E-DV3 train programs."""
+    from ...ops.conv_einsum import resolve_conv_impl
+
+    return bool(cnn_keys) and resolve_conv_impl(str(wm_cfg.select("conv_impl", "auto")))
+
+
+def decode_obs_dists(wm_apply, wm_params, wm_cls, latents, batch_obs, cnn_keys, mlp_keys, phase: bool):
+    """Decoder distributions + matching observation targets for the
+    reconstruction loss. ``phase=True`` decodes the cnn keys in phase space
+    ([..., I, I, 2, 2, C], skipping the depth-to-space interleave whose
+    backward transpose dominates the CPU gradient step) and phase-splits the
+    gradient-free targets; the summed MSE is identical either way."""
+    from ...distributions import MSEDistribution, SymlogDistribution
+    from ...ops.conv_einsum import phase_split_nhwc
+
+    if phase:
+        recon = wm_apply(wm_params, wm_cls.decode_phases, latents)
+        po = {k: MSEDistribution(recon[k], dims=5) for k in cnn_keys}
+        targets = dict(batch_obs)
+        for k in cnn_keys:
+            targets[k] = phase_split_nhwc(batch_obs[k])
+    else:
+        recon = wm_apply(wm_params, wm_cls.decode, latents)
+        po = {k: MSEDistribution(recon[k], dims=3) for k in cnn_keys}
+        targets = batch_obs
+    po.update({k: SymlogDistribution(recon[k], dims=1) for k in mlp_keys})
+    return po, targets
+
+
 def make_precision_applies(cfg: Any, wm, actor, critic):
     """The single mixed-precision cast boundary shared by the DV3-family
     train steps (dreamer_v3 / p2e_dv3): network forwards run in

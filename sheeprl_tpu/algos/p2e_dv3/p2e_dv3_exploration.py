@@ -38,7 +38,6 @@ from ...distributions import (
     Independent,
     MSEDistribution,
     OneHotCategoricalStraightThrough,
-    SymlogDistribution,
     TwoHotEncodingDistribution,
 )
 from ...ops import lambda_values as lambda_values_op
@@ -57,6 +56,7 @@ from ..dreamer_v3.agent import WorldModel, actor_dists, sample_actor_actions
 from ..dreamer_v3.dreamer_v3 import make_player
 from ..dreamer_v3.loss import reconstruction_loss
 from ..dreamer_v3.utils import (  # noqa: F401
+    decode_obs_dists,
     extract_masks,
     init_moments,
     make_ens_apply,
@@ -65,6 +65,7 @@ from ..dreamer_v3.utils import (  # noqa: F401
     prepare_obs,
     test,
     update_moments,
+    use_phase_obs_loss,
 )
 from .agent import build_agent
 
@@ -130,6 +131,8 @@ def make_train_fn(
     wm_apply, actor_apply, critic_apply, _cast, _cdt, _ = make_precision_applies(
         cfg, wm, actor, critic
     )
+    # phase-space observation loss rides the einsum decoder (decode_phases)
+    phase_obs_loss = use_phase_obs_loss(wm_cfg, cnn_keys)
     ens_apply_c = make_ens_apply(ens_apply, _cast, _cdt)
 
     def moments_step(moments, lv):
@@ -171,9 +174,9 @@ def make_train_fn(
             )
             latents = jnp.concatenate([zs, hs], axis=-1)
             latents_sg = jax.lax.stop_gradient(latents)
-            recon = wm_apply(wm_params, WorldModel.decode, latents)
-            po = {k: MSEDistribution(recon[k], dims=3) for k in cnn_keys}
-            po.update({k: SymlogDistribution(recon[k], dims=1) for k in mlp_keys})
+            po, obs_targets = decode_obs_dists(
+                wm_apply, wm_params, WorldModel, latents, batch_obs, cnn_keys, mlp_keys, phase_obs_loss
+            )
             # reward/continue on detached latents (reference :160-165)
             pr = TwoHotEncodingDistribution(
                 wm_apply(wm_params, WorldModel.reward, latents_sg), dims=1
@@ -186,7 +189,7 @@ def make_train_fn(
             rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = (
                 reconstruction_loss(
                     po,
-                    batch_obs,
+                    obs_targets,
                     pr,
                     batch["rewards"],
                     prior_logits.reshape(T, B, S, D),

@@ -89,10 +89,17 @@ def conv2d_k4s2(x: jax.Array, kernel: jax.Array, padding: Padding) -> jax.Array:
 _TR_TAPS = ({0: 0, 1: 2}, {1: 1, 2: 3})
 
 
-def conv_transpose2d_k4s2p1(x: jax.Array, kernel: jax.Array) -> jax.Array:
+def conv_transpose2d_k4s2p1(x: jax.Array, kernel: jax.Array, phases: bool = False) -> jax.Array:
     """NHWC transposed conv, kernel [4, 4, C_out, C_in] (nn.ConvTranspose
     transpose_kernel=True layout), stride 2, torch padding 1 (flax explicit
-    padding ((2,2),(2,2))). Output spatial dims are exactly 2x the input's."""
+    padding ((2,2),(2,2))). Output spatial dims are exactly 2x the input's.
+
+    ``phases=True`` returns the raw per-phase output [N, I, I, 2, 2, C_out]
+    (``out[..., m, n, rh, rw, :]`` is interleaved pixel ``(2m+rh, 2n+rw)``)
+    and skips the depth-to-space interleave — whose *backward* transpose is
+    the single most expensive op of the CPU DV3 gradient step. Training can
+    evaluate the observation MSE directly in phase space against a
+    `phase_split_nhwc` of the (gradient-free) target."""
     kh, kw, cout, cin = kernel.shape
     assert (kh, kw) == (4, 4), (kh, kw)
     w = jnp.transpose(kernel[::-1, ::-1], (0, 1, 3, 2))  # flip + [4,4,CI,CO]
@@ -113,11 +120,24 @@ def conv_transpose2d_k4s2p1(x: jax.Array, kernel: jax.Array) -> jax.Array:
             wc = jnp.stack(blocks, axis=1).reshape(cin, 4 * cout)
             t = jnp.einsum("nhwc,cd->nhwd", xp[:, u : u + ih, v : v + iw, :], wc)
             y = t if y is None else y + t
-    # depth-to-space: [N, I, I, (rh, rw, CO)] -> [N, 2I, 2I, CO]
-    return (
-        y.reshape(n, ih, iw, 2, 2, cout)
-        .transpose(0, 1, 3, 2, 4, 5)
-        .reshape(n, 2 * ih, 2 * iw, cout)
+    y = y.reshape(n, ih, iw, 2, 2, cout)
+    if phases:
+        return y
+    # depth-to-space: [N, I, I, rh, rw, CO] -> [N, 2I, 2I, CO]
+    return y.transpose(0, 1, 3, 2, 4, 5).reshape(n, 2 * ih, 2 * iw, cout)
+
+
+def phase_split_nhwc(x: jax.Array) -> jax.Array:
+    """[..., 2I, 2J, C] -> [..., I, J, 2, 2, C] with
+    ``out[..., m, n, rh, rw, :] == x[..., 2m+rh, 2n+rw, :]`` — the inverse of
+    the depth-to-space interleave, built from strided slices (no transposed
+    copy). Used to bring the observation *target* into phase space."""
+    return jnp.stack(
+        [
+            jnp.stack([x[..., rh::2, rw::2, :] for rw in (0, 1)], axis=-2)
+            for rh in (0, 1)
+        ],
+        axis=-3,
     )
 
 
@@ -151,9 +171,10 @@ class EinsumConvTranspose4x4S2(nn.Module):
     bias_init: Callable = nn.initializers.zeros_init()
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, phases: bool = False) -> jax.Array:
         kernel = self.param("kernel", self.kernel_init, (4, 4, self.features, x.shape[-1]))
-        y = conv_transpose2d_k4s2p1(x, kernel)
+        y = conv_transpose2d_k4s2p1(x, kernel, phases=phases)
         if self.use_bias:
+            # bias broadcasts over the trailing feature axis in both layouts
             y = y + self.param("bias", self.bias_init, (self.features,))
         return y

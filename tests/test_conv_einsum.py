@@ -11,6 +11,7 @@ from sheeprl_tpu.algos.dreamer_v3.agent import DV3CNNDecoder, DV3CNNEncoder
 from sheeprl_tpu.ops.conv_einsum import (
     conv2d_k4s2,
     conv_transpose2d_k4s2p1,
+    phase_split_nhwc,
     resolve_conv_impl,
 )
 
@@ -83,6 +84,37 @@ def test_dv3_modules_param_compatible_across_impls(module, make_input):
     a, b = jax.tree.leaves(out_x), jax.tree.leaves(out_e)
     for r, g in zip(a, b):
         np.testing.assert_allclose(r, g, rtol=1e-4, atol=1e-4)
+
+
+def test_phase_output_matches_interleaved():
+    """phases=True output is exactly the phase_split of the interleaved
+    output, and the phase-space MSE equals the pixel-space MSE."""
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 4)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((4, 4, 3, 4)), jnp.float32) * 0.1  # [4,4,CO,CI]
+    full = conv_transpose2d_k4s2p1(x, k)
+    ph = conv_transpose2d_k4s2p1(x, k, phases=True)
+    assert ph.shape == (2, 8, 8, 2, 2, 3)
+    np.testing.assert_allclose(phase_split_nhwc(full), ph, atol=1e-6)
+
+    target = jnp.asarray(rng.standard_normal(full.shape), jnp.float32)
+    mse_pixel = jnp.square(full - target).sum()
+    mse_phase = jnp.square(ph - phase_split_nhwc(target)).sum()
+    np.testing.assert_allclose(mse_pixel, mse_phase, rtol=1e-6)
+
+
+@pytest.mark.parametrize("impl", ["einsum", "xla"])
+def test_decoder_cnn_phases(impl):
+    """DV3CNNDecoder(cnn_phases=True) is the phase_split of the interleaved
+    decode, whichever conv lowering is selected."""
+    rng = np.random.default_rng(4)
+    latent = jnp.asarray(rng.standard_normal((2, 3, 48)), jnp.float32)
+    mod = DV3CNNDecoder(keys=("rgb",), output_channels=(3,), channels_multiplier=4, conv_impl=impl)
+    params = mod.init(jax.random.key(0), latent)
+    full = mod.apply(params, latent)["rgb"]
+    ph = mod.apply(params, latent, cnn_phases=True)["rgb"]
+    assert ph.shape == full.shape[:-3] + (32, 32, 2, 2, 3)
+    np.testing.assert_allclose(phase_split_nhwc(full), ph, atol=1e-5)
 
 
 def test_resolve_conv_impl():
