@@ -188,6 +188,7 @@ class DV1WorldModel(nn.Module):
     reward_dense_units: Optional[int] = None
     continue_mlp_layers: Optional[int] = None
     continue_dense_units: Optional[int] = None
+    conv_impl: str = "auto"
 
     def setup(self) -> None:
         self.encoder = DV2Encoder(
@@ -198,6 +199,7 @@ class DV1WorldModel(nn.Module):
             dense_units=self.encoder_dense_units or self.dense_units,
             layer_norm=False,
             cnn_act=self.cnn_act,
+            conv_impl=self.conv_impl,
             dense_act=self.dense_act,
         )
         self.rssm = DV1RSSM(
@@ -304,6 +306,7 @@ def build_agent(
         cnn_channels_multiplier=int(wm_cfg.encoder.cnn_channels_multiplier),
         mlp_layers=int(cfg.algo.mlp_layers),
         dense_units=int(cfg.algo.dense_units),
+        conv_impl=str(wm_cfg.select("conv_impl", "auto")),
         stochastic_size=int(wm_cfg.stochastic_size),
         recurrent_state_size=int(wm_cfg.recurrent_model.recurrent_state_size),
         hidden_size=int(wm_cfg.transition_model.hidden_size),

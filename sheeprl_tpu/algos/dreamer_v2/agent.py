@@ -34,6 +34,7 @@ from ...distributions import (
     TruncatedNormal,
 )
 from ...models import MLP, LayerNorm, LayerNormGRUCell
+from ...ops.conv_einsum import conv4x4s2, resolve_conv_impl
 from .utils import compute_stochastic_state
 
 
@@ -51,23 +52,25 @@ class DV2CNNEncoder(nn.Module):
     layer_norm: bool = False
     activation: str = "elu"
     stages: int = 4
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
         from ...models.models import get_activation
 
+        einsum_convs = resolve_conv_impl(self.conv_impl)
         act = get_activation(self.activation)
         x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
         lead = x.shape[:-3]
         x = x.reshape((-1,) + x.shape[-3:])
         for i in range(self.stages):
-            x = nn.Conv(
+            x = conv4x4s2(
                 (2**i) * self.channels_multiplier,
-                (4, 4),
-                strides=(2, 2),
-                padding="VALID",
+                padding=((0, 0), (0, 0)),  # VALID
                 use_bias=not self.layer_norm,
                 name=f"conv_{i}",
+                einsum=einsum_convs,
+                spatial=(x.shape[-3], x.shape[-2]),
             )(x)
             if self.layer_norm:
                 x = LayerNorm()(x)
@@ -102,6 +105,7 @@ class DV2Encoder(nn.Module):
     layer_norm: bool = False
     cnn_act: str = "elu"
     dense_act: str = "elu"
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
@@ -109,7 +113,11 @@ class DV2Encoder(nn.Module):
         if self.cnn_keys:
             feats.append(
                 DV2CNNEncoder(
-                    self.cnn_keys, self.cnn_channels_multiplier, self.layer_norm, self.cnn_act
+                    self.cnn_keys,
+                    self.cnn_channels_multiplier,
+                    self.layer_norm,
+                    self.cnn_act,
+                    conv_impl=self.conv_impl,
                 )(obs)
             )
         if self.mlp_keys:
@@ -401,6 +409,7 @@ class DV2WorldModel(nn.Module):
     reward_dense_units: Optional[int] = None
     continue_mlp_layers: Optional[int] = None
     continue_dense_units: Optional[int] = None
+    conv_impl: str = "auto"
 
     def setup(self) -> None:
         self.encoder = DV2Encoder(
@@ -412,6 +421,7 @@ class DV2WorldModel(nn.Module):
             layer_norm=self.layer_norm,
             cnn_act=self.cnn_act,
             dense_act=self.dense_act,
+            conv_impl=self.conv_impl,
         )
         self.rssm = DV2RSSM(
             stochastic_size=self.stochastic_size,
@@ -619,6 +629,7 @@ def build_agent(
         cnn_channels_multiplier=int(wm_cfg.encoder.cnn_channels_multiplier),
         mlp_layers=int(cfg.algo.mlp_layers),
         dense_units=int(cfg.algo.dense_units),
+        conv_impl=str(wm_cfg.select("conv_impl", "auto")),
         stochastic_size=int(wm_cfg.stochastic_size),
         discrete_size=int(wm_cfg.discrete_size),
         recurrent_state_size=int(wm_cfg.recurrent_model.recurrent_state_size),

@@ -38,8 +38,8 @@ from ...config.instantiate import locate
 from ...models import MLP, LayerNorm, LayerNormGRUCell
 from ...ops import symlog
 from ...ops.conv_einsum import (
-    EinsumConv4x4S2,
     EinsumConvTranspose4x4S2,
+    conv4x4s2,
     phase_split_nhwc,
     resolve_conv_impl,
 )
@@ -94,25 +94,15 @@ class DV3CNNEncoder(nn.Module):
         lead = x.shape[:-3]
         x = x.reshape((-1,) + x.shape[-3:])
         for i in range(self.stages):
-            if einsum_convs:
-                conv = EinsumConv4x4S2(
-                    (2**i) * self.channels_multiplier,
-                    padding=((1, 1), (1, 1)),
-                    use_bias=not self.layer_norm,
-                    kernel_init=xavier_normal,
-                    name=f"conv_{i}",
-                )
-            else:
-                conv = nn.Conv(
-                    (2**i) * self.channels_multiplier,
-                    (4, 4),
-                    strides=(2, 2),
-                    padding=((1, 1), (1, 1)),
-                    use_bias=not self.layer_norm,
-                    kernel_init=xavier_normal,
-                    name=f"conv_{i}",
-                )
-            x = conv(x)
+            x = conv4x4s2(
+                (2**i) * self.channels_multiplier,
+                padding=((1, 1), (1, 1)),
+                use_bias=not self.layer_norm,
+                kernel_init=xavier_normal,
+                name=f"conv_{i}",
+                einsum=einsum_convs,
+                spatial=(x.shape[-3], x.shape[-2]),
+            )(x)
             if self.layer_norm:
                 x = LayerNorm(eps=1e-3)(x)
             x = nn.silu(x)

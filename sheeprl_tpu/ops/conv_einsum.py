@@ -160,6 +160,32 @@ class EinsumConv4x4S2(nn.Module):
         return y
 
 
+def conv4x4s2(
+    features: int,
+    *,
+    padding: Padding,
+    use_bias: bool = True,
+    kernel_init: Callable | None = None,
+    name: str | None = None,
+    einsum: bool = False,
+    spatial: Tuple[int, int] | None = None,
+) -> nn.Module:
+    """Factory for a 4x4/stride-2 conv stage: the einsum lowering when
+    requested AND the padded spatial dims are even (pass ``spatial`` to
+    check — VALID-padded odd stages must fall back), else the equivalent
+    ``nn.Conv``. Both choices declare identical parameter trees. Shared by
+    the DV3 and DV1/DV2 encoders so impl-selection logic lives in one place."""
+    if einsum and spatial is not None:
+        (pt, pb), (pl, pr) = padding
+        einsum = (spatial[0] + pt + pb) % 2 == 0 and (spatial[1] + pl + pr) % 2 == 0
+    kw = {} if kernel_init is None else {"kernel_init": kernel_init}
+    if einsum:
+        return EinsumConv4x4S2(features, padding=padding, use_bias=use_bias, name=name, **kw)
+    return nn.Conv(
+        features, (4, 4), strides=(2, 2), padding=padding, use_bias=use_bias, name=name, **kw
+    )
+
+
 class EinsumConvTranspose4x4S2(nn.Module):
     """Drop-in for ``nn.ConvTranspose(features, (4, 4), strides=(2, 2),
     padding=((2, 2), (2, 2)), transpose_kernel=True)`` with an identical

@@ -117,6 +117,22 @@ def test_decoder_cnn_phases(impl):
     np.testing.assert_allclose(phase_split_nhwc(full), ph, atol=1e-5)
 
 
+def test_dv2_encoder_param_compatible_across_impls():
+    """DV2/DV1 shared encoder (k4 s2 VALID, odd stages fall back to native):
+    same param tree and outputs whichever lowering is selected."""
+    from sheeprl_tpu.algos.dreamer_v2.agent import DV2CNNEncoder
+
+    rng = np.random.default_rng(5)
+    obs = {"rgb": jnp.asarray(rng.standard_normal((3, 2, 64, 64, 3)), jnp.float32)}
+    m_xla = DV2CNNEncoder(keys=("rgb",), channels_multiplier=2, conv_impl="xla")
+    m_ein = DV2CNNEncoder(keys=("rgb",), channels_multiplier=2, conv_impl="einsum")
+    p = m_xla.init(jax.random.key(0), obs)
+    assert jax.tree.structure(p) == jax.tree.structure(m_ein.init(jax.random.key(0), obs))
+    np.testing.assert_allclose(
+        m_xla.apply(p, obs), m_ein.apply(p, obs), rtol=1e-4, atol=1e-4
+    )
+
+
 def test_resolve_conv_impl():
     assert resolve_conv_impl("einsum") is True
     assert resolve_conv_impl("xla") is False
