@@ -226,6 +226,7 @@ class DV1WorldModel(nn.Module):
             layer_norm=False,
             cnn_act=self.cnn_act,
             dense_act=self.dense_act,
+            conv_impl=self.conv_impl,
         )
         self.reward_model = DV2Head(
             1,

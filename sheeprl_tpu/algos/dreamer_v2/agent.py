@@ -34,7 +34,7 @@ from ...distributions import (
     TruncatedNormal,
 )
 from ...models import MLP, LayerNorm, LayerNormGRUCell
-from ...ops.conv_einsum import conv4x4s2, resolve_conv_impl
+from ...ops.conv_einsum import conv4x4s2, deconv_s2_valid, resolve_conv_impl
 from .utils import compute_stochastic_state
 
 
@@ -70,7 +70,6 @@ class DV2CNNEncoder(nn.Module):
                 use_bias=not self.layer_norm,
                 name=f"conv_{i}",
                 einsum=einsum_convs,
-                spatial=(x.shape[-3], x.shape[-2]),
             )(x)
             if self.layer_norm:
                 x = LayerNorm()(x)
@@ -140,11 +139,13 @@ class DV2CNNDecoder(nn.Module):
     cnn_encoder_output_dim: int
     layer_norm: bool = False
     activation: str = "elu"
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
         from ...models.models import get_activation
 
+        custom_grad = resolve_conv_impl(self.conv_impl)
         act = get_activation(self.activation)
         lead = latent.shape[:-1]
         x = nn.Dense(self.cnn_encoder_output_dim, name="fc")(latent)
@@ -152,19 +153,18 @@ class DV2CNNDecoder(nn.Module):
         channels = [4 * self.channels_multiplier, 2 * self.channels_multiplier, self.channels_multiplier]
         kernels = [5, 5, 6, 6]
         for i, ch in enumerate(channels):
-            x = nn.ConvTranspose(
+            x = deconv_s2_valid(
                 ch,
                 (kernels[i], kernels[i]),
-                strides=(2, 2),
-                padding="VALID",
                 use_bias=not self.layer_norm,
                 name=f"deconv_{i}",
+                custom_grad=custom_grad,
             )(x)
             if self.layer_norm:
                 x = LayerNorm()(x)
             x = act(x)
-        x = nn.ConvTranspose(
-            sum(self.output_channels), (kernels[3], kernels[3]), strides=(2, 2), padding="VALID", name="to_obs"
+        x = deconv_s2_valid(
+            sum(self.output_channels), (kernels[3], kernels[3]), name="to_obs", custom_grad=custom_grad
         )(x)
         x = x.reshape(lead + x.shape[1:])
         out: Dict[str, jax.Array] = {}
@@ -208,6 +208,7 @@ class DV2Decoder(nn.Module):
     layer_norm: bool = False
     cnn_act: str = "elu"
     dense_act: str = "elu"
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
@@ -221,6 +222,7 @@ class DV2Decoder(nn.Module):
                     self.cnn_encoder_output_dim,
                     self.layer_norm,
                     self.cnn_act,
+                    conv_impl=self.conv_impl,
                 )(latent)
             )
         if self.mlp_keys:
@@ -448,6 +450,7 @@ class DV2WorldModel(nn.Module):
             layer_norm=self.layer_norm,
             cnn_act=self.cnn_act,
             dense_act=self.dense_act,
+            conv_impl=self.conv_impl,
         )
         self.reward_model = DV2Head(
             1,

@@ -101,7 +101,6 @@ class DV3CNNEncoder(nn.Module):
                 kernel_init=xavier_normal,
                 name=f"conv_{i}",
                 einsum=einsum_convs,
-                spatial=(x.shape[-3], x.shape[-2]),
             )(x)
             if self.layer_norm:
                 x = LayerNorm(eps=1e-3)(x)

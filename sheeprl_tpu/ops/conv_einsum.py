@@ -57,16 +57,26 @@ def resolve_conv_impl(impl: str) -> bool:
 def conv2d_k4s2(x: jax.Array, kernel: jax.Array, padding: Padding) -> jax.Array:
     """NHWC conv, kernel [4, 4, C_in, C_out] (nn.Conv layout), stride 2.
 
-    Requires (H + pad_top + pad_bottom) and (W + pad_left + pad_right) even —
-    true for every Dreamer stage (64/32/16/8 with pad 1+1 or VALID).
+    Odd padded spatial dims (e.g. the 31x31 second DV1/DV2 VALID stage) are
+    zero-padded one more row/column on the high side to make space-to-depth
+    blocking possible; the one extra (invalid) output row/column this creates
+    is cropped at the end.
     """
     kh, kw, cin, cout = kernel.shape
     assert (kh, kw) == (4, 4), (kh, kw)
     (pt, pb), (pl, pr) = padding
-    xp = jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+    ho_t = (x.shape[1] + pt + pb - 4) // 2 + 1
+    wo_t = (x.shape[2] + pl + pr - 4) // 2 + 1
+    xp = jnp.pad(
+        x,
+        (
+            (0, 0),
+            (pt, pb + (x.shape[1] + pt + pb) % 2),
+            (pl, pr + (x.shape[2] + pl + pr) % 2),
+            (0, 0),
+        ),
+    )
     n, hp, wp = xp.shape[0], xp.shape[1], xp.shape[2]
-    if hp % 2 or wp % 2:
-        raise ValueError(f"padded spatial dims must be even, got {(hp, wp)}")
     a, b = hp // 2, wp // 2
     # space-to-depth: [N, A, B, (dr, dc, C)]
     xsd = xp.reshape(n, a, 2, b, 2, cin).transpose(0, 1, 3, 2, 4, 5).reshape(n, a, b, 4 * cin)
@@ -76,7 +86,7 @@ def conv2d_k4s2(x: jax.Array, kernel: jax.Array, padding: Padding) -> jax.Array:
         .transpose(0, 2, 1, 3, 4, 5)
         .reshape(2, 2, 4 * cin, cout)
     )
-    return _shifted_matmul_sum(xsd, ksd)
+    return _shifted_matmul_sum(xsd, ksd)[:, :ho_t, :wo_t, :]
 
 
 def _pow2_chunks(m: int, target: int = 32768) -> int:
@@ -248,6 +258,124 @@ class EinsumConv4x4S2(nn.Module):
         return y
 
 
+def _chunked_outer(a: jax.Array, b: jax.Array) -> jax.Array:
+    """sum_m a[m, :] ⊗ b[m, :] -> [ca, cb], accumulated over power-of-two row
+    blocks in f32 so the operand transposes stay cache-resident (the same
+    rationale as _smm_bwd's kernel-gradient path)."""
+    m = a.shape[0]
+    dims = (((0,), (0,)), ((), ()))
+    nb = _pow2_chunks(m)
+    if nb == 1:
+        return jax.lax.dot_general(a, b, dims, preferred_element_type=jnp.float32)
+    blk = m // nb
+    ab = a.reshape(nb, blk, a.shape[1])
+    bb = b.reshape(nb, blk, b.shape[1])
+
+    def body(acc, xs):
+        return acc + jax.lax.dot_general(xs[0], xs[1], dims, preferred_element_type=jnp.float32), None
+
+    out, _ = jax.lax.scan(body, jnp.zeros((a.shape[1], b.shape[1]), jnp.float32), (ab, bb))
+    return out
+
+
+@jax.custom_vjp
+def conv_transpose_s2_valid(x: jax.Array, kernel: jax.Array) -> jax.Array:
+    """NHWC transposed conv, stride 2, VALID padding, any kernel size —
+    the DV1/DV2 decoder stages (k5/k6; flax nn.ConvTranspose default
+    ``transpose_kernel=False`` layout [kh, kw, C_in, C_out]). Output spatial
+    dims are (I-1)*2 + k.
+
+    Forward and input-gradient stay native XLA convolutions (the fast
+    class); only the kernel gradient is hand-written: XLA CPU compiles the
+    autodiff kernel-grad convolution (rhs-dilated) pathologically inside
+    large programs — ~1.9 s of the DV2 tiny-bench gradient step for the
+    final 3-channel deconv alone. It becomes per-tap chunked GEMMs over
+    phase-split cotangent slices instead."""
+    return jax.lax.conv_transpose(
+        x, kernel, (2, 2), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
+def _cts2_fwd(x, kernel):
+    return conv_transpose_s2_valid(x, kernel), (x, kernel)
+
+
+def _cts2_bwd(res, dy):
+    x, kernel = res
+    kh, kw, cin, cout = kernel.shape
+    n, ih, iw = x.shape[0], x.shape[1], x.shape[2]
+    m = n * ih * iw
+    # lax.conv_transpose scatters x[i]·K[d] to output 2i + (k-1-d): the
+    # kernel acts spatially FLIPPED relative to the tap index below
+    # (forward is native, so only this hand-written backward cares)
+    # input gradient: dx[i] = sum_e dy[2i + e] @ Kflip[e].T — a plain
+    # strided conv of the cotangent, contracting output channels ("HWOI")
+    dx = jax.lax.conv_general_dilated(
+        dy, kernel[::-1, ::-1], (2, 2), "VALID", dimension_numbers=("NHWC", "HWOI", "NHWC")
+    ).astype(x.dtype)
+    # kernel gradient: dKflip[e] = sum_i x[i] ⊗ dy[2i + e]; slice the
+    # cotangent per tap via its stride-2 phase split (contiguous after the
+    # split), then un-flip
+    xf = x.reshape(m, cin)
+    phases = [[dy[:, rh::2, rw::2, :] for rw in (0, 1)] for rh in (0, 1)]
+    rows = []
+    for dh in range(kh):
+        cols = []
+        for dw in range(kw):
+            ph = phases[dh % 2][dw % 2]
+            sl = ph[:, dh // 2 : dh // 2 + ih, dw // 2 : dw // 2 + iw, :]
+            cols.append(_chunked_outer(xf, sl.reshape(m, cout)))
+        rows.append(jnp.stack(cols))
+    dk = jnp.stack(rows)[::-1, ::-1].astype(kernel.dtype)
+    return dx, dk
+
+
+conv_transpose_s2_valid.defvjp(_cts2_fwd, _cts2_bwd)
+
+
+class CustomGradConvTransposeS2Valid(nn.Module):
+    """Drop-in for ``nn.ConvTranspose(features, (k, k), strides=(2, 2),
+    padding="VALID")`` with an identical parameter tree; same forward, the
+    CPU-friendly custom gradient above."""
+
+    features: int
+    kernel_size: Tuple[int, int]
+    use_bias: bool = True
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param(
+            "kernel", self.kernel_init, self.kernel_size + (x.shape[-1], self.features)
+        )
+        y = conv_transpose_s2_valid(x, kernel)
+        if self.use_bias:
+            y = y + self.param("bias", self.bias_init, (self.features,))
+        return y
+
+
+def deconv_s2_valid(
+    features: int,
+    kernel_size: Tuple[int, int],
+    *,
+    use_bias: bool = True,
+    name: str | None = None,
+    custom_grad: bool = False,
+) -> nn.Module:
+    """Factory for a stride-2 VALID transposed-conv stage (the DV1/DV2
+    decoder): the custom-gradient wrapper when requested, else the
+    equivalent ``nn.ConvTranspose``. Identical parameter trees either way.
+    Lives next to `conv4x4s2` so impl-selection logic stays in one place."""
+    if custom_grad:
+        return CustomGradConvTransposeS2Valid(
+            features, kernel_size, use_bias=use_bias, name=name
+        )
+    return nn.ConvTranspose(
+        features, kernel_size, strides=(2, 2), padding="VALID", use_bias=use_bias, name=name
+    )
+
+
 def conv4x4s2(
     features: int,
     *,
@@ -256,16 +384,11 @@ def conv4x4s2(
     kernel_init: Callable | None = None,
     name: str | None = None,
     einsum: bool = False,
-    spatial: Tuple[int, int] | None = None,
 ) -> nn.Module:
     """Factory for a 4x4/stride-2 conv stage: the einsum lowering when
-    requested AND the padded spatial dims are even (pass ``spatial`` to
-    check — VALID-padded odd stages must fall back), else the equivalent
-    ``nn.Conv``. Both choices declare identical parameter trees. Shared by
-    the DV3 and DV1/DV2 encoders so impl-selection logic lives in one place."""
-    if einsum and spatial is not None:
-        (pt, pb), (pl, pr) = padding
-        einsum = (spatial[0] + pt + pb) % 2 == 0 and (spatial[1] + pl + pr) % 2 == 0
+    requested, else the equivalent ``nn.Conv``. Both choices declare
+    identical parameter trees. Shared by the DV3 and DV1/DV2 encoders so
+    impl-selection logic lives in one place."""
     kw = {} if kernel_init is None else {"kernel_init": kernel_init}
     if einsum:
         return EinsumConv4x4S2(features, padding=padding, use_bias=use_bias, name=name, **kw)

@@ -18,10 +18,14 @@ from sheeprl_tpu.ops.conv_einsum import (
 DN = ("NHWC", "HWIO", "NHWC")
 
 
-@pytest.mark.parametrize("padding", [((1, 1), (1, 1)), ((0, 0), (0, 0))])
-def test_conv2d_k4s2_matches_native(padding):
+@pytest.mark.parametrize("padding,size", [
+    (((1, 1), (1, 1)), 16),
+    (((0, 0), (0, 0)), 16),
+    (((0, 0), (0, 0)), 31),  # odd VALID stage (DV1/DV2 64->31->14): pad+crop path
+])
+def test_conv2d_k4s2_matches_native(padding, size):
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((4, 16, 16, 3)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((4, size, size, 3)), jnp.float32)
     w = jnp.asarray(rng.standard_normal((4, 4, 3, 5)), jnp.float32) * 0.1
     ref = lax.conv_general_dilated(x, w, (2, 2), padding, dimension_numbers=DN)
     got = conv2d_k4s2(x, w, padding)
@@ -130,6 +134,50 @@ def test_dv2_encoder_param_compatible_across_impls():
     assert jax.tree.structure(p) == jax.tree.structure(m_ein.init(jax.random.key(0), obs))
     np.testing.assert_allclose(
         m_xla.apply(p, obs), m_ein.apply(p, obs), rtol=1e-4, atol=1e-4
+    )
+
+
+@pytest.mark.parametrize("k,ih", [(5, 1), (5, 5), (6, 13), (6, 30)])
+def test_conv_transpose_s2_valid_custom_grad(k, ih):
+    """DV1/DV2 decoder deconvs (k5/k6 s2 VALID): native forward, custom
+    gradient — both must match flax nn.ConvTranspose and its autodiff."""
+    from sheeprl_tpu.ops.conv_einsum import conv_transpose_s2_valid
+
+    rng = np.random.default_rng(6)
+    ci, co = 4, 3
+    x = jnp.asarray(rng.standard_normal((2, ih, ih, ci)), jnp.float32)
+    mod = nn.ConvTranspose(co, (k, k), strides=(2, 2), padding="VALID", use_bias=False)
+    params = mod.init(jax.random.key(0), x)
+    kern = params["params"]["kernel"]
+    ref = mod.apply(params, x)
+    got = conv_transpose_s2_valid(x, kern)
+    assert got.shape == ref.shape == (2, 2 * (ih - 1) + k, 2 * (ih - 1) + k, co)
+    np.testing.assert_allclose(ref, got, atol=1e-5)
+
+    g_ref = jax.grad(lambda kern, x: (mod.apply({"params": {"kernel": kern}}, x) ** 2).sum(), argnums=(0, 1))(kern, x)
+    g_got = jax.grad(lambda kern, x: (conv_transpose_s2_valid(x, kern) ** 2).sum(), argnums=(0, 1))(kern, x)
+    for r, g in zip(g_ref, g_got):
+        np.testing.assert_allclose(r, g, rtol=1e-4, atol=1e-3)
+
+
+def test_dv2_decoder_param_compatible_across_impls():
+    """DV1/DV2 shared decoder: same param tree and outputs whichever
+    lowering is selected (checkpoint interchangeability)."""
+    from sheeprl_tpu.algos.dreamer_v2.agent import DV2CNNDecoder
+
+    rng = np.random.default_rng(7)
+    latent = jnp.asarray(rng.standard_normal((3, 2, 32)), jnp.float32)
+    mk = lambda impl: DV2CNNDecoder(
+        keys=("rgb",), output_channels=(3,), channels_multiplier=2,
+        cnn_encoder_output_dim=64, conv_impl=impl,
+    )
+    m_xla, m_cg = mk("xla"), mk("einsum")
+    p = m_xla.init(jax.random.key(0), latent)
+    assert jax.tree.structure(p) == jax.tree.structure(m_cg.init(jax.random.key(0), latent))
+    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(m_cg.init(jax.random.key(0), latent))):
+        assert a.shape == b.shape
+    np.testing.assert_allclose(
+        m_xla.apply(p, latent)["rgb"], m_cg.apply(p, latent)["rgb"], rtol=1e-4, atol=1e-4
     )
 
 
