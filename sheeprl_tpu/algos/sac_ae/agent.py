@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models import MLP, LayerNorm
+from ...ops.conv_einsum import conv3x3s2_valid, deconv_s2_valid, resolve_conv_impl
 from ..sac.agent import LOG_STD_MAX, LOG_STD_MIN
 
 
@@ -32,15 +33,21 @@ class SACAECNNEncoder(nn.Module):
     keys: Sequence[str]
     features_dim: int
     channels_multiplier: int = 1
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array], detach_conv: bool = False) -> jax.Array:
+        einsum_convs = resolve_conv_impl(self.conv_impl)
         x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
         m = 32 * self.channels_multiplier
         for i, stride in enumerate((2, 1, 1, 1)):
-            x = nn.relu(
-                nn.Conv(m, (3, 3), strides=(stride, stride), padding="VALID", name=f"conv_{i}")(x)
-            )
+            if stride == 2:
+                # the only strided stage — the one whose kernel-gradient
+                # conv XLA CPU compiles pathologically (ops/conv_einsum.py)
+                conv = conv3x3s2_valid(m, name=f"conv_{i}", einsum=einsum_convs)
+            else:
+                conv = nn.Conv(m, (3, 3), strides=(1, 1), padding="VALID", name=f"conv_{i}")
+            x = nn.relu(conv(x))
         x = jnp.reshape(x, x.shape[:-3] + (-1,))
         if detach_conv:
             x = jax.lax.stop_gradient(x)
@@ -73,15 +80,17 @@ class SACAEEncoder(nn.Module):
     dense_units: int = 64
     mlp_layers: int = 2
     layer_norm: bool = False
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array], detach_conv: bool = False) -> jax.Array:
         feats = []
         if self.cnn_keys:
             feats.append(
-                SACAECNNEncoder(self.cnn_keys, self.features_dim, self.channels_multiplier)(
-                    obs, detach_conv
-                )
+                SACAECNNEncoder(
+                    self.cnn_keys, self.features_dim, self.channels_multiplier,
+                    conv_impl=self.conv_impl,
+                )(obs, detach_conv)
             )
         if self.mlp_keys:
             feats.append(
@@ -96,6 +105,7 @@ class SACAECNNDecoder(nn.Module):
     conv_output_shape: Tuple[int, int, int]  # (H, W, C) of the encoder convs
     channels_multiplier: int = 1
     screen_size: int = 64
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, features: jax.Array) -> Dict[str, jax.Array]:
@@ -104,10 +114,15 @@ class SACAECNNDecoder(nn.Module):
         x = nn.Dense(h * w * c, name="fc")(features)
         x = jnp.reshape(x, x.shape[:-1] + (h, w, c))
         for i in range(3):
+            # stride-1 deconvs are the fast class; only the strided to_obs
+            # kernel gradient needs the custom path
             x = nn.relu(
                 nn.ConvTranspose(m, (3, 3), strides=(1, 1), padding="VALID", name=f"deconv_{i}")(x)
             )
-        x = nn.ConvTranspose(sum(self.key_channels), (3, 3), strides=(2, 2), padding="VALID", name="to_obs")(x)
+        x = deconv_s2_valid(
+            sum(self.key_channels), (3, 3), name="to_obs",
+            custom_grad=resolve_conv_impl(self.conv_impl),
+        )(x)
         # torch output_padding=1 equivalent: pad one row/col to reach screen_size
         pad_h = self.screen_size - x.shape[-3]
         pad_w = self.screen_size - x.shape[-2]
@@ -143,6 +158,7 @@ class SACAEDecoder(nn.Module):
     screen_size: int = 64
     dense_units: int = 64
     mlp_layers: int = 2
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, features: jax.Array) -> Dict[str, jax.Array]:
@@ -155,6 +171,7 @@ class SACAEDecoder(nn.Module):
                     self.conv_output_shape,
                     self.channels_multiplier,
                     self.screen_size,
+                    conv_impl=self.conv_impl,
                 )(features)
             )
         if self.mlp_keys:
@@ -243,6 +260,7 @@ def build_agent(
         dense_units=cfg.algo.dense_units,
         mlp_layers=cfg.algo.mlp_layers,
         layer_norm=cfg.algo.layer_norm,
+        conv_impl=str(cfg.algo.select("conv_impl", "auto")),
     )
     key_channels = [observation_space[k].shape[-1] for k in cnn_keys]
     mlp_dims = [int(np.prod(observation_space[k].shape)) for k in mlp_keys]
@@ -256,6 +274,7 @@ def build_agent(
         screen_size=screen,
         dense_units=cfg.algo.dense_units,
         mlp_layers=cfg.algo.mlp_layers,
+        conv_impl=str(cfg.algo.select("conv_impl", "auto")),
     )
     qs = make_q_ensemble(cfg.algo.hidden_size, int(cfg.algo.critic.n))
     actor = SACAEActor(

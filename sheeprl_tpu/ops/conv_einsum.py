@@ -355,6 +355,39 @@ class CustomGradConvTransposeS2Valid(nn.Module):
         return y
 
 
+class EinsumConv3x3S2Valid(nn.Module):
+    """Drop-in for ``nn.Conv(features, (3, 3), strides=(2, 2),
+    padding="VALID")`` (the SAC-AE first pixel conv): the 3x3 kernel is
+    zero-extended to 4x4 and routed through the k4/s2 einsum core — the
+    extra tap row/column has zero weight and reads one extra zero-padded
+    input row/column, so outputs are exact for any input size. Parameter
+    tree matches nn.Conv ([3, 3, C_in, features])."""
+
+    features: int
+    use_bias: bool = True
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param("kernel", self.kernel_init, (3, 3, x.shape[-1], self.features))
+        k44 = jnp.pad(kernel, ((0, 1), (0, 1), (0, 0), (0, 0)))
+        y = conv2d_k4s2(x, k44, ((0, 1), (0, 1)))
+        if self.use_bias:
+            y = y + self.param("bias", self.bias_init, (self.features,))
+        return y
+
+
+def conv3x3s2_valid(
+    features: int, *, use_bias: bool = True, name: str | None = None, einsum: bool = False
+) -> nn.Module:
+    """Factory for a 3x3/stride-2 VALID conv stage (SAC-AE): the einsum
+    lowering when requested, else the equivalent ``nn.Conv``."""
+    if einsum:
+        return EinsumConv3x3S2Valid(features, use_bias=use_bias, name=name)
+    return nn.Conv(features, (3, 3), strides=(2, 2), padding="VALID", use_bias=use_bias, name=name)
+
+
 def deconv_s2_valid(
     features: int,
     kernel_size: Tuple[int, int],

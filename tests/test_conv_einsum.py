@@ -137,7 +137,7 @@ def test_dv2_encoder_param_compatible_across_impls():
     )
 
 
-@pytest.mark.parametrize("k,ih", [(5, 1), (5, 5), (6, 13), (6, 30)])
+@pytest.mark.parametrize("k,ih", [(3, 4), (3, 31), (5, 1), (5, 5), (6, 13), (6, 30)])
 def test_conv_transpose_s2_valid_custom_grad(k, ih):
     """DV1/DV2 decoder deconvs (k5/k6 s2 VALID): native forward, custom
     gradient — both must match flax nn.ConvTranspose and its autodiff."""
@@ -179,6 +179,50 @@ def test_dv2_decoder_param_compatible_across_impls():
     np.testing.assert_allclose(
         m_xla.apply(p, latent)["rgb"], m_cg.apply(p, latent)["rgb"], rtol=1e-4, atol=1e-4
     )
+
+
+@pytest.mark.parametrize("size", [64, 21])
+def test_conv3x3s2_valid_matches_native(size):
+    """SAC-AE first pixel conv (k3 s2 VALID): the zero-extended-k4 einsum
+    path must match nn.Conv exactly, params interchangeable."""
+    from sheeprl_tpu.ops.conv_einsum import EinsumConv3x3S2Valid
+
+    rng = np.random.default_rng(8)
+    x = jnp.asarray(rng.standard_normal((2, size, size, 9)), jnp.float32)
+    ref = nn.Conv(8, (3, 3), strides=(2, 2), padding="VALID")
+    got = EinsumConv3x3S2Valid(8)
+    p = ref.init(jax.random.key(0), x)
+    assert jax.tree.structure(p) == jax.tree.structure(got.init(jax.random.key(0), x))
+    np.testing.assert_allclose(ref.apply(p, x), got.apply(p, x), rtol=1e-4, atol=1e-4)
+
+    g_ref = jax.grad(lambda p: (ref.apply(p, x) ** 2).sum())(p)
+    g_got = jax.grad(lambda p: (got.apply(p, x) ** 2).sum())(p)
+    for r, g in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_got)):
+        np.testing.assert_allclose(r, g, rtol=1e-3, atol=1e-2)
+
+
+def test_sac_ae_modules_param_compatible_across_impls():
+    from sheeprl_tpu.algos.sac_ae.agent import SACAECNNDecoder, SACAECNNEncoder
+
+    rng = np.random.default_rng(9)
+    obs = {"rgb": jnp.asarray(rng.standard_normal((2, 64, 64, 3)), jnp.float32)}
+    e_xla = SACAECNNEncoder(keys=("rgb",), features_dim=8, conv_impl="xla")
+    e_ein = SACAECNNEncoder(keys=("rgb",), features_dim=8, conv_impl="einsum")
+    p = e_xla.init(jax.random.key(0), obs)
+    assert jax.tree.structure(p) == jax.tree.structure(e_ein.init(jax.random.key(0), obs))
+    np.testing.assert_allclose(e_xla.apply(p, obs), e_ein.apply(p, obs), rtol=1e-4, atol=1e-4)
+
+    feats = jnp.asarray(rng.standard_normal((2, 8)), jnp.float32)
+    # (25, 25, 32) is the real encoder conv output for 64px screens: the
+    # decoder then emits 63x63 and the output-padding branch (63 -> 64)
+    # is exercised
+    d_xla = SACAECNNDecoder(keys=("rgb",), key_channels=(3,), conv_output_shape=(25, 25, 32), conv_impl="xla")
+    d_ein = SACAECNNDecoder(keys=("rgb",), key_channels=(3,), conv_output_shape=(25, 25, 32), conv_impl="einsum")
+    pd = d_xla.init(jax.random.key(0), feats)
+    assert jax.tree.structure(pd) == jax.tree.structure(d_ein.init(jax.random.key(0), feats))
+    out_x = d_xla.apply(pd, feats)["rgb"]
+    assert out_x.shape == (2, 64, 64, 3)
+    np.testing.assert_allclose(out_x, d_ein.apply(pd, feats)["rgb"], rtol=1e-4, atol=1e-4)
 
 
 def test_resolve_conv_impl():
