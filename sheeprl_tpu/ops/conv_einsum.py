@@ -134,30 +134,43 @@ def _smm_bwd(res, dy):
     k, _, cin, d = wc.shape
     n, ih, iw = dy.shape[0], dy.shape[1], dy.shape[2]
     hp, wp = xp.shape[1], xp.shape[2]
-    m = n * ih * iw
-    dyf = dy.reshape(m, d).astype(wc.dtype)
-
-    # kernel gradient: blocked accumulation, transposes stay cache-resident
-    nb = _pow2_chunks(m)
-    slices = [
-        xp[:, u : u + ih, v : v + iw, :].reshape(m, cin).astype(wc.dtype)
-        for u in range(k)
-        for v in range(k)
-    ]
-    # partial sums accumulate in f32 (a bf16 carry would compound rounding
-    # across the nb scan iterations ~7x worse than one f32-internal GEMM)
+    # kernel gradient: blocked over the BATCH dim, so the k*k shifted slices
+    # are cut from a cache-resident chunk inside the scan body instead of
+    # being materialized whole ([n*ih*iw, cin] x k^2 was ~0.4 s/step of
+    # slice fusions in the DV3 tiny bench). Partial sums accumulate in f32
+    # (a bf16 carry would compound rounding across the scan iterations ~7x
+    # worse than one f32-internal GEMM).
     dims = (((0,), (0,)), ((), ()))
-    if nb == 1:
-        dwc_flat = [
-            jax.lax.dot_general(s, dyf, dims, preferred_element_type=jnp.float32)
-            for s in slices
-        ]
-    else:
-        blk = m // nb
-        dyb = dyf.reshape(nb, blk, d)
-        xsb = [s.reshape(nb, blk, cin) for s in slices]
+    nb = _pow2_chunks(n, target=max(1, 32768 // (ih * iw)))
 
-        def body(acc, inputs):
+    def _tap_dots(xpc, dyc, acc=None):
+        dyf = dyc.reshape(-1, d).astype(wc.dtype)
+        outs = []
+        i = 0
+        for u in range(k):
+            for v in range(k):
+                sl = xpc[:, u : u + ih, v : v + iw, :].reshape(-1, cin).astype(wc.dtype)
+                t = jax.lax.dot_general(sl, dyf, dims, preferred_element_type=jnp.float32)
+                outs.append(t if acc is None else acc[i] + t)
+                i += 1
+        return outs
+
+    m = n * ih * iw
+    if nb == 1 and _pow2_chunks(m) > 1:
+        # odd/batch-1 inputs with large frames: batch-dim blocking is
+        # unavailable, but flattened-row blocking still keeps the GEMM
+        # transposes cache-resident (at the cost of materializing the k*k
+        # shifted slices once)
+        mb = _pow2_chunks(m)
+        blk = m // mb
+        dyb = dy.reshape(mb, blk, d).astype(wc.dtype)
+        slices = [
+            xp[:, u : u + ih, v : v + iw, :].reshape(mb, blk, cin).astype(wc.dtype)
+            for u in range(k)
+            for v in range(k)
+        ]
+
+        def body_flat(acc, inputs):
             dyc = inputs[0]
             return [
                 a + jax.lax.dot_general(xc, dyc, dims, preferred_element_type=jnp.float32)
@@ -165,7 +178,20 @@ def _smm_bwd(res, dy):
             ], None
 
         dwc_flat, _ = jax.lax.scan(
-            body, [jnp.zeros((cin, d), jnp.float32) for _ in slices], (dyb, *xsb)
+            body_flat, [jnp.zeros((cin, d), jnp.float32) for _ in range(k * k)], (dyb, *slices)
+        )
+    elif nb == 1:
+        dwc_flat = _tap_dots(xp, dy)
+    else:
+        blk = n // nb
+        xpb = xp.reshape(nb, blk, hp, wp, xp.shape[-1])
+        dyb = dy.reshape(nb, blk, ih, iw, d)
+
+        def body(acc, inputs):
+            return _tap_dots(inputs[0], inputs[1], acc), None
+
+        dwc_flat, _ = jax.lax.scan(
+            body, [jnp.zeros((cin, d), jnp.float32) for _ in range(k * k)], (xpb, dyb)
         )
     dwc = jnp.stack([jnp.stack(dwc_flat[u * k : (u + 1) * k]) for u in range(k)]).astype(wc.dtype)
 

@@ -18,14 +18,15 @@ from sheeprl_tpu.ops.conv_einsum import (
 DN = ("NHWC", "HWIO", "NHWC")
 
 
-@pytest.mark.parametrize("padding,size", [
-    (((1, 1), (1, 1)), 16),
-    (((0, 0), (0, 0)), 16),
-    (((0, 0), (0, 0)), 31),  # odd VALID stage (DV1/DV2 64->31->14): pad+crop path
+@pytest.mark.parametrize("padding,batch,size", [
+    (((1, 1), (1, 1)), 4, 16),
+    (((0, 0), (0, 0)), 4, 16),
+    (((0, 0), (0, 0)), 4, 31),  # odd VALID stage (DV1/DV2 64->31->14): pad+crop path
+    (((1, 1), (1, 1)), 1, 128),  # batch-1 large frame: flat-rows bwd fallback
 ])
-def test_conv2d_k4s2_matches_native(padding, size):
+def test_conv2d_k4s2_matches_native(padding, batch, size):
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((4, size, size, 3)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((batch, size, size, 3)), jnp.float32)
     w = jnp.asarray(rng.standard_normal((4, 4, 3, 5)), jnp.float32) * 0.1
     ref = lax.conv_general_dilated(x, w, (2, 2), padding, dimension_numbers=DN)
     got = conv2d_k4s2(x, w, padding)
