@@ -67,6 +67,8 @@ DREAMER_EXPS = {
 }
 DREAMER_TOTAL_STEPS = int(os.environ.get("BENCH_DREAMER_STEPS", 16_384))
 
+PREFLIGHT_BUDGET_DEFAULT_S = 180.0  # shared by the default path and subcommands
+
 
 def _timed_cli_run(args: list, steps: int, baseline_seconds: float, baseline_steps: int, metric: str) -> dict:
     """Run a recipe through the CLI (training output → stderr), timing it and
@@ -210,6 +212,20 @@ def _maybe_force_cpu() -> None:
 def main() -> None:
     arg = sys.argv[1] if len(sys.argv) > 1 else ""
     if arg in RECIPE_EXPS or arg in DREAMER_EXPS or arg == "dv3_step":
+        if not os.environ.get("BENCH_FORCE_CPU") and not os.environ.get("BENCH_PREFLIGHT_DONE"):
+            # standalone subcommand run (the default path already preflighted
+            # and marks its subprocesses with BENCH_PREFLIGHT_DONE): probe the
+            # link once under a budget so a dead tunnel degrades to a labeled
+            # CPU measurement instead of hanging on device client creation
+            budget = float(os.environ.get("BENCH_PREFLIGHT_BUDGET_S", PREFLIGHT_BUDGET_DEFAULT_S))
+            pre = _run_subprocess_record(["preflight"], budget)
+            if pre is None or not pre.get("ok"):
+                print(
+                    f"[bench] {arg}: preflight failed within {budget}s; "
+                    "running on the host CPU backend (BENCH_FORCE_CPU=1)",
+                    file=sys.stderr,
+                )
+                os.environ["BENCH_FORCE_CPU"] = "1"
         _maybe_force_cpu()
     if arg in RECIPE_EXPS:
         print(json.dumps(bench_recipe(arg)))
@@ -235,8 +251,14 @@ def main() -> None:
         os.environ.setdefault(
             "JAX_COMPILATION_CACHE_DIR", os.path.expanduser(DEFAULT_XLA_CACHE_DIR)
         )
-        preflight_budget = float(os.environ.get("BENCH_PREFLIGHT_BUDGET_S", 180))
+        preflight_budget = float(
+            os.environ.get("BENCH_PREFLIGHT_BUDGET_S", PREFLIGHT_BUDGET_DEFAULT_S)
+        )
         retries = max(1, int(os.environ.get("BENCH_PREFLIGHT_RETRIES", 3)))
+        # subcommand subprocesses must not re-probe (a transient blip could
+        # silently flip a child to CPU while the parent labels the headline
+        # with the accelerator platform)
+        os.environ["BENCH_PREFLIGHT_DONE"] = "1"
         # a pre-set BENCH_FORCE_CPU skips the accelerator probe entirely —
         # the operator typically sets it BECAUSE the link is dead, and the
         # probe would just burn the whole preflight budget hanging
