@@ -30,8 +30,15 @@ def main() -> None:
         print(f"[keep_best] no parseable record in {sys.argv[1]}")
         return
     plat = str(rec.get("platform", ""))
-    if plat.startswith("cpu"):
+    if not plat or plat.startswith("cpu"):
         print(f"[keep_best] platform={plat!r} — not an accelerator record, skipping")
+        return
+    # only healthy END-TO-END headlines compete: a promoted compute-only
+    # record (e2e leg failed) uses a different baseline, so its vs_baseline
+    # is not comparable — keeping it would lock out every later real run
+    if "e2e_error" in rec or "error" in rec or rec.get("unit") != "env steps/sec":
+        print(f"[keep_best] not a healthy e2e headline (unit={rec.get('unit')!r}, "
+              f"error={rec.get('error') or rec.get('e2e_error')!r}), skipping")
         return
     cur = last_record(BEST)
     if cur is not None and cur.get("vs_baseline", 0) >= rec.get("vs_baseline", 0):

@@ -156,10 +156,10 @@ def section_link_bandwidth(rec: dict) -> None:
     for mb in (1, 8):
         x = np.random.default_rng(1).integers(0, 255, (mb * 1024 * 1024,), dtype=np.uint8)
         t = _timeit(lambda _x=x: jax.block_until_ready(jax.device_put(_x)), warmup=1, iters=5)
-        out[f"h2d_{mb}mb_mbps"] = round(mb / t, 2)
+        out[f"h2d_{mb}mb_mbytes_per_s"] = round(mb / t, 2)
         y = jax.device_put(x)
         t = _timeit(lambda _y=y: np.asarray(_y), warmup=1, iters=5)
-        out[f"d2h_{mb}mb_mbps"] = round(mb / t, 2)
+        out[f"d2h_{mb}mb_mbytes_per_s"] = round(mb / t, 2)
     rec["link_bandwidth"] = out
     _log(f"link: {out}")
 
@@ -185,9 +185,12 @@ def main() -> None:
             rec["errors"][name] = traceback.format_exc(limit=10)
             _log(f"section {name} FAILED:\n{rec['errors'][name]}")
     rec["elapsed_seconds"] = round(time.perf_counter() - t0, 1)
-    rec["ok"] = not rec["errors"] and rec.get("pallas_gru", {}).get("sizes", {}).get(
-        "S", {}
-    ).get("parity", False)
+    gru_sizes = rec.get("pallas_gru", {}).get("sizes", {})
+    rec["ok"] = (
+        not rec["errors"]
+        and all(gru_sizes.get(s, {}).get("parity", False) for s in ("XS", "S"))
+        and rec.get("device_ring", {}).get("parity", False)
+    )
     print(json.dumps(rec))
 
 

@@ -29,6 +29,7 @@ from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
+from ...utils import run_info
 from ...utils.timer import timer
 from ...utils.utils import WallClockStopper, save_configs, wall_cap_reached
 from ..ppo.utils import prepare_obs, test
@@ -201,6 +202,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             data = {k: jax.device_put(v, dist.batch_sharding) for k, v in data.items()}
             params, opt_state, metrics = update(params, opt_state, data)
             mirror.refresh(params)  # blocking: next rollout acts with fresh params
+            run_info.mark_steady(policy_step)
 
         for k, v in metrics.items():
             aggregator.update(k, np.asarray(v))

@@ -49,6 +49,7 @@ from ...utils.env import episode_stats, patch_restarted_envs, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
+from ...utils import run_info
 from ...utils.timer import timer
 from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
 from .agent import Actor, WorldModel, build_agent, compute_stochastic_state, sample_actor_actions
@@ -733,6 +734,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                         file=sys.stderr,
                         flush=True,
                     )
+                run_info.mark_steady(policy_step)
             if policy_step < total_steps:
                 # overlap the next sample + host→HBM transfer with the train
                 # step the device is computing right now

@@ -40,6 +40,7 @@ from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
+from ...utils import run_info
 from ...utils.timer import timer
 from ...utils.utils import Ratio, WallClockStopper, linear_annealing, save_configs, wall_cap_reached
 from .agent import PPOAgent, actions_and_log_probs, build_agent
@@ -306,6 +307,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             root_key, up_key = jax.random.split(root_key)
             params, opt_state, metrics = update(params, opt_state, data, coefs, up_key)
             mirror.refresh(params)  # blocking: next rollout acts with fresh params
+            run_info.mark_steady(policy_step)
 
         if aggregator is not None:
             for k, v in metrics.items():

@@ -35,6 +35,7 @@ from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
+from ...utils import run_info
 from ...utils.timer import timer
 from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
 from .agent import SACActor, build_agent, sample_actions
@@ -282,6 +283,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                     # skip entirely when metrics are off (bench legs)
                     pending_metrics.append(metrics)
                 mirror.refresh({"actor": params["actor"]})
+                run_info.mark_steady(policy_step)
             if policy_step < total_steps:
                 prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
 
