@@ -348,13 +348,15 @@ def main() -> None:
                 "end-to-end leg failed or exceeded its budget; compute-only record promoted"
             )
             if cpu_fallback:
-                # keep the dead-link cause on the promoted headline too
+                # keep the dead-link / forced-CPU cause on the promoted headline too
                 step_rec["platform"] = "cpu-fallback" if preflight_failed else "cpu-forced"
-                if preflight_failed:
-                    step_rec["error"] = (
-                        "accelerator preflight failed (device client creation hung); "
-                        "this is a host-CPU measurement"
-                    )
+                step_rec["error"] = (
+                    "accelerator preflight failed (device client creation hung); "
+                    "this is a host-CPU measurement"
+                    if preflight_failed
+                    else "cpu forced via BENCH_FORCE_CPU (preflight not the cause); "
+                    "this is a host-CPU measurement"
+                )
             print(json.dumps(step_rec))
         else:
             print(

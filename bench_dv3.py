@@ -49,11 +49,13 @@ def _peak_flops(device) -> float | None:
 
 
 def _measured_peak_flops() -> float:
-    """Achievable dense-matmul FLOP/s on the current backend, measured with a
-    jitted 1024³ f32 matmul (best of 5). The MFU denominator when the device
-    kind has no spec-sheet entry — notably the host CPU on fallback runs, so
-    utilization is recorded on EVERY bench path (labeled as measured, not
-    vendor peak)."""
+    """Achievable dense-matmul FLOP/s on the host CPU backend, measured with
+    a jitted 1024³ f32 matmul (best of 5). The MFU denominator on fallback
+    runs, so utilization is recorded on EVERY bench path (labeled as
+    measured, not vendor peak). CPU-only: a 2.1 GFLOP matmul is milliseconds
+    there, far above dispatch noise — on a fast unknown accelerator it would
+    be latency-dominated and overstate MFU, so non-CPU unknowns omit mfu
+    instead."""
     import jax
     import jax.numpy as jnp
 
@@ -218,20 +220,24 @@ def record() -> dict:
     }
     if flops_per_step is not None:
         rec["model_flops_per_step"] = flops_per_step
-        peak = _peak_flops(jax.devices()[0])
+        dev0 = jax.devices()[0]
+        peak = _peak_flops(dev0)
         if peak is not None:
             rec["peak_flops_basis"] = "vendor bf16 peak by device_kind"
-        else:
+        elif dev0.platform == "cpu":
             peak = _measured_peak_flops()
+            rec["peak_flops_basis"] = "measured 1024^3 f32 matmul on cpu (not vendor peak)"
+        else:
             rec["peak_flops_basis"] = (
-                f"measured 1024^3 f32 matmul on {jax.devices()[0].platform} (not vendor peak)"
+                f"unknown device_kind {getattr(dev0, 'device_kind', '')!r}; mfu omitted"
             )
-        # flops_per_step and sps are whole-mesh quantities; normalize the
-        # peak by the device count so multi-chip runs report true MFU
-        n_dev = jax.device_count()
-        rec["mfu"] = round(flops_per_step * sps / (peak * n_dev), 4)
-        rec["peak_flops_assumed"] = peak
-        rec["devices"] = n_dev
+        if peak is not None:
+            # flops_per_step and sps are whole-mesh quantities; normalize the
+            # peak by the device count so multi-chip runs report true MFU
+            n_dev = jax.device_count()
+            rec["mfu"] = round(flops_per_step * sps / (peak * n_dev), 4)
+            rec["peak_flops_assumed"] = peak
+            rec["devices"] = n_dev
     return rec
 
 

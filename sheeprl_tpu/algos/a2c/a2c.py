@@ -99,6 +99,7 @@ def main(dist: Distributed, cfg: Config) -> None:
         obs_keys=obs_keys,
         memmap=cfg.buffer.memmap,
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+        seed=cfg.seed + 1024 * rank,
     )
 
     from ..ppo.ppo import make_act_fn, make_value_fn

@@ -334,6 +334,7 @@ def main(dist: Distributed, cfg: Config) -> None:
         if cfg.buffer.memmap
         else None,
         buffer_cls=SequentialReplayBuffer,
+        seed=cfg.seed + 1024 * rank,
     )
     if state and cfg.buffer.checkpoint and "rb" in state:
         rb.load_state_dict(state["rb"])
@@ -496,7 +497,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                     # skip entirely when metrics are off (bench legs)
                     pending_metrics.append(metrics)
                 mirror.refresh({"wm": params["wm"], "actor": params["actor"]})
-                run_info.mark_steady(policy_step)
+                run_info.mark_steady(policy_step, sync=lambda: jax.block_until_ready(metrics))
             if policy_step < total_steps:
                 prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
 

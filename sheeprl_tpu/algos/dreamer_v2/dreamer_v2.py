@@ -404,6 +404,7 @@ def _build_buffer(cfg: Config, num_envs: int, obs_keys, log_dir: str, rank: int)
             memmap=cfg.buffer.memmap,
             memmap_dir=memmap_dir,
             buffer_cls=SequentialReplayBuffer,
+            seed=cfg.seed + 1024 * rank,
         )
     if buffer_type == "episode":
         return EpisodeBuffer(
@@ -416,6 +417,7 @@ def _build_buffer(cfg: Config, num_envs: int, obs_keys, log_dir: str, rank: int)
             else False,
             memmap=cfg.buffer.memmap,
             memmap_dir=memmap_dir,
+            seed=cfg.seed + 1024 * rank,
         )
     raise ValueError(
         f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}"
@@ -649,7 +651,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                     # skip entirely when metrics are off (bench legs)
                     pending_metrics.append(metrics)
                 mirror.refresh({"wm": params["wm"], "actor": params["actor"]})
-                run_info.mark_steady(policy_step)
+                run_info.mark_steady(policy_step, sync=lambda: jax.block_until_ready(metrics))
             if policy_step < total_steps:
                 prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
 

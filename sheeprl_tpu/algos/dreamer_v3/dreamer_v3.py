@@ -530,6 +530,7 @@ def main(dist: Distributed, cfg: Config) -> None:
         memmap=cfg.buffer.memmap,
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
         buffer_cls=SequentialReplayBuffer,
+        seed=cfg.seed + 1024 * rank,
     )
     if state and cfg.buffer.checkpoint and "rb" in state:
         rb.load_state_dict(state["rb"])
@@ -734,7 +735,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                         file=sys.stderr,
                         flush=True,
                     )
-                run_info.mark_steady(policy_step)
+                run_info.mark_steady(policy_step, sync=lambda: jax.block_until_ready(metrics))
             if policy_step < total_steps:
                 # overlap the next sample + host→HBM transfer with the train
                 # step the device is computing right now

@@ -173,6 +173,7 @@ def main(dist: Distributed, cfg: Config) -> None:
         num_envs,
         memmap=cfg.buffer.memmap,
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+        seed=cfg.seed + 1024 * rank,
     )
     if state and cfg.buffer.checkpoint and "rb" in state:
         rb.load_state_dict(state["rb"])

@@ -123,6 +123,7 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
         if cfg.buffer.memmap
         else None,
         buffer_cls=SequentialReplayBuffer,
+        seed=cfg.seed + 1024 * rank,
     )
     if resume and cfg.buffer.checkpoint and "rb" in state:
         rb.load_state_dict(state["rb"])

@@ -97,6 +97,7 @@ def _player_loop(
             memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0")
             if cfg.buffer.memmap
             else None,
+            seed=cfg.seed,  # decoupled: one player thread owns the buffer
         )
 
         # per-step inference on the player device (host CPU when the mesh is

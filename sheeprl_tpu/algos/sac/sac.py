@@ -168,6 +168,7 @@ def main(dist: Distributed, cfg: Config) -> None:
         num_envs,
         memmap=cfg.buffer.memmap,
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+        seed=cfg.seed + 1024 * rank,
     )
     if state and cfg.buffer.checkpoint and "rb" in state:
         rb.load_state_dict(state["rb"])
@@ -283,7 +284,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                     # skip entirely when metrics are off (bench legs)
                     pending_metrics.append(metrics)
                 mirror.refresh({"actor": params["actor"]})
-                run_info.mark_steady(policy_step)
+                run_info.mark_steady(policy_step, sync=lambda: jax.block_until_ready(metrics))
             if policy_step < total_steps:
                 prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
 

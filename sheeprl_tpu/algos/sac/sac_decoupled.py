@@ -79,6 +79,7 @@ def _player_loop(
             memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0")
             if cfg.buffer.memmap
             else None,
+            seed=cfg.seed,  # decoupled: one player thread owns the buffer
         )
         if state and cfg.buffer.checkpoint and "rb" in state:
             rb.load_state_dict(state["rb"])

@@ -49,12 +49,18 @@ class ReplayBuffer:
         obs_keys: Sequence[str] = ("observations",),
         memmap: bool = False,
         memmap_dir: Optional[Union[str, os.PathLike]] = None,
+        seed: Optional[Any] = None,
         **kwargs: Any,
     ):
         if buffer_size <= 0:
             raise ValueError(f"buffer_size must be > 0, got {buffer_size}")
         if n_envs <= 0:
             raise ValueError(f"n_envs must be > 0, got {n_envs}")
+        # sampling draws come from an OWNED, checkpointed generator (not the
+        # process-global np.random the reference uses): state_dict carries
+        # its state, so a resumed run replays the same sample stream
+        # (`seed` accepts an int or a np.random.SeedSequence)
+        self._rng = np.random.default_rng(seed)
         self._buffer_size = int(buffer_size)
         self._n_envs = int(n_envs)
         self._obs_keys = tuple(obs_keys)
@@ -186,15 +192,15 @@ class ReplayBuffer:
                 # the slot right before _pos has its "next" overwritten by the
                 # write head (reference :230 SB3-derived comment): valid
                 # indices are [pos, pos+size-1) mod size — everything but pos-1
-                idxs = (self._pos + np.random.randint(0, valid - 1, size=total)) % self._buffer_size
+                idxs = (self._pos + self._rng.integers(0, valid - 1, size=total)) % self._buffer_size
             else:
-                idxs = np.random.randint(0, valid, size=total)
+                idxs = self._rng.integers(0, valid, size=total)
         else:
             upper = self._pos - 1 if sample_next_obs else self._pos
             if upper <= 0:
                 raise RuntimeError("Not enough data to sample next observations")
-            idxs = np.random.randint(0, upper, size=total)
-        env_idxs = np.random.randint(0, self._n_envs, size=total)
+            idxs = self._rng.integers(0, upper, size=total)
+        env_idxs = self._rng.integers(0, self._n_envs, size=total)
         return idxs, env_idxs
 
     def _gather(
@@ -238,6 +244,7 @@ class ReplayBuffer:
             "buffer": {k: np.asarray(v).copy() for k, v in self._buf.items()},
             "pos": self._pos,
             "full": self._full,
+            "rng": self._rng.bit_generator.state,
         }
 
     def checkpoint_state_dict(self) -> Dict[str, Any]:
@@ -260,6 +267,8 @@ class ReplayBuffer:
         self._pos = int(state["pos"])
         self._full = bool(state["full"])
         self._added = int(state["pos"]) + (self._buffer_size if state["full"] else 0)
+        if state.get("rng") is not None:  # absent in pre-r5 checkpoints
+            self._rng.bit_generator.state = state["rng"]
         return self
 
     @staticmethod
@@ -290,9 +299,9 @@ class SequentialReplayBuffer(ReplayBuffer):
             # cross the write head
             first_valid = self._pos
             n_valid = self._buffer_size - L + 1
-            offsets = np.random.randint(0, n_valid, size=total)
+            offsets = self._rng.integers(0, n_valid, size=total)
             return (first_valid + offsets) % self._buffer_size
-        return np.random.randint(0, self._pos - L + 1, size=total)
+        return self._rng.integers(0, self._pos - L + 1, size=total)
 
     def sample(  # type: ignore[override]
         self,
@@ -310,7 +319,7 @@ class SequentialReplayBuffer(ReplayBuffer):
         L = sequence_length
         total = batch_size * n_samples
         starts = self.sample_starts(total, L)
-        env_idxs = np.random.randint(0, self._n_envs, size=total)
+        env_idxs = self._rng.integers(0, self._n_envs, size=total)
         seq = (starts[:, None] + np.arange(L)[None, :]) % self._buffer_size  # [total, L]
         # flat (time, env) row indices in FINAL [n_samples, L, batch] order —
         # the native gather writes the training layout directly, skipping the
@@ -361,9 +370,14 @@ class EnvIndependentReplayBuffer:
         memmap: bool = False,
         memmap_dir: Optional[Union[str, os.PathLike]] = None,
         buffer_cls: type = SequentialReplayBuffer,
+        seed: Optional[Any] = None,
         **kwargs: Any,
     ):
         mdir = Path(memmap_dir) if memmap_dir is not None else None
+        # one SeedSequence fans out to the cross-env multinomial (child 0)
+        # and each sub-buffer (children 1..n) — independent, resumable streams
+        children = np.random.SeedSequence(seed).spawn(n_envs + 1)
+        self._rng = np.random.default_rng(children[0])
         self._buffers: List[ReplayBuffer] = [
             buffer_cls(
                 buffer_size,
@@ -371,6 +385,7 @@ class EnvIndependentReplayBuffer:
                 obs_keys=obs_keys,
                 memmap=memmap,
                 memmap_dir=None if mdir is None else mdir / f"env_{i}",
+                seed=children[i + 1],
                 **kwargs,
             )
             for i in range(n_envs)
@@ -424,7 +439,7 @@ class EnvIndependentReplayBuffer:
         ready = [b for b in self._buffers if not b.empty and (b.full or b._pos > 0)]
         if not ready:
             raise ValueError("No data in the buffer, cannot sample")
-        split = np.random.multinomial(batch_size, [1 / len(ready)] * len(ready))
+        split = self._rng.multinomial(batch_size, [1 / len(ready)] * len(ready))
         parts = [
             b.sample(int(bs), n_samples=n_samples, **kwargs)
             for b, bs in zip(ready, split)
@@ -443,12 +458,18 @@ class EnvIndependentReplayBuffer:
         return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
     def state_dict(self) -> Dict[str, Any]:
-        return {"buffers": [b.state_dict() for b in self._buffers]}
+        return {
+            "buffers": [b.state_dict() for b in self._buffers],
+            "rng": self._rng.bit_generator.state,
+        }
 
     def checkpoint_state_dict(self) -> Dict[str, Any]:
         """Per-env truncated-flag surgery at each sub-buffer's write position
         (reference callback.py:112-116); see ReplayBuffer.checkpoint_state_dict."""
-        return {"buffers": [b.checkpoint_state_dict() for b in self._buffers]}
+        return {
+            "buffers": [b.checkpoint_state_dict() for b in self._buffers],
+            "rng": self._rng.bit_generator.state,
+        }
 
     def mark_restart(self, env_idx: int) -> None:
         """After an in-flight env restart (RestartOnException fired without a
@@ -464,6 +485,8 @@ class EnvIndependentReplayBuffer:
     def load_state_dict(self, state: Dict[str, Any]) -> "EnvIndependentReplayBuffer":
         for b, s in zip(self._buffers, state["buffers"]):
             b.load_state_dict(s)
+        if state.get("rng") is not None:  # absent in pre-r5 checkpoints
+            self._rng.bit_generator.state = state["rng"]
         return self
 
 
@@ -480,6 +503,7 @@ class EpisodeBuffer:
         prioritize_ends: bool = False,
         memmap: bool = False,
         memmap_dir: Optional[Union[str, os.PathLike]] = None,
+        seed: Optional[Any] = None,
         **kwargs: Any,
     ):
         if buffer_size <= 0:
@@ -495,6 +519,7 @@ class EpisodeBuffer:
         self._prioritize_ends = prioritize_ends
         self._memmap = memmap
         self._memmap_dir = Path(memmap_dir) if memmap_dir is not None else None
+        self._rng = np.random.default_rng(seed)
         self._episodes: List[Dict[str, np.ndarray]] = []
         self._open: List[Optional[Dict[str, List[np.ndarray]]]] = [None] * n_envs
         self._cum_len = 0
@@ -632,7 +657,7 @@ class EpisodeBuffer:
         lengths = np.array([len(next(iter(ep.values()))) for ep in valid])
         weights = lengths / lengths.sum()
         total = batch_size * n_samples
-        ep_idx = np.random.choice(len(valid), size=total, p=weights)
+        ep_idx = self._rng.choice(len(valid), size=total, p=weights)
         samples: Dict[str, List[np.ndarray]] = {}
         for i in ep_idx:
             ep = valid[i]
@@ -640,9 +665,9 @@ class EpisodeBuffer:
             upper = ep_len - sequence_length + 1
             if prioritize_ends:
                 # bias starts so episode ends are reachable (reference :1092-1096)
-                start = min(np.random.randint(0, ep_len), upper - 1)
+                start = min(int(self._rng.integers(0, ep_len)), upper - 1)
             else:
-                start = np.random.randint(0, upper)
+                start = int(self._rng.integers(0, upper))
             for k, v in ep.items():
                 samples.setdefault(k, []).append(v[start : start + sequence_length])
         out: Dict[str, np.ndarray] = {}
@@ -670,6 +695,7 @@ class EpisodeBuffer:
                 for o in self._open
             ],
             "cum_len": self._cum_len,
+            "rng": self._rng.bit_generator.state,
         }
 
     def checkpoint_state_dict(self) -> Dict[str, Any]:
@@ -695,4 +721,6 @@ class EpisodeBuffer:
         self._episodes = episodes
         self._open = state["open"]
         self._cum_len = int(state["cum_len"])
+        if state.get("rng") is not None:  # absent in pre-r5 checkpoints
+            self._rng.bit_generator.state = state["rng"]
         return self

@@ -202,7 +202,7 @@ class DeviceRingPrefetcher:
         ]
         if not ready:
             raise ValueError("No data in the buffer, cannot sample")
-        split = np.random.multinomial(B, [1 / len(ready)] * len(ready))
+        split = rb._rng.multinomial(B, [1 / len(ready)] * len(ready))
         starts_cols: List[np.ndarray] = []
         env_order: List[int] = []
         for (e, b), bs in zip(ready, split):

@@ -14,12 +14,19 @@ from typing import Any, Dict
 last_run: Dict[str, Any] = {}
 
 
-def mark_steady(policy_step: int) -> None:
+def mark_steady(policy_step: int, sync: Any = None) -> None:
     """Record the end of the FIRST completed training burst: the jit
     compile(s) happen inside that burst, so the steady-state window for SPS
     starts here. Called once per run from each training loop; the bench
     driver derives ``steady_state_sps`` = (final_step - steady_step) /
-    (t_end - steady_t) from it (VERDICT r4 item 6)."""
+    (t_end - steady_t) from it (VERDICT r4 item 6).
+
+    ``sync``: loops whose train dispatch is async pass a block-until-ready
+    thunk; it runs only on the first call, so the stamp lands after the
+    burst's device execution (not just its dispatch) at zero steady-state
+    cost."""
     if "steady_step" not in last_run:
+        if sync is not None:
+            sync()
         last_run["steady_step"] = int(policy_step)
         last_run["steady_t"] = time.perf_counter()

@@ -103,3 +103,73 @@ def test_episode_buffer_checkpoint_drops_open_episodes():
     resumed = EpisodeBuffer(100, minimum_episode_length=2, n_envs=2)
     resumed.load_state_dict(state)
     assert all(o is None for o in resumed._open)
+
+
+# -- seeded, checkpointed sample streams (VERDICT r4 item 7) ------------------
+
+
+def test_replay_sampling_rng_rides_the_checkpoint():
+    """The sample stream is OWNED buffer state: restoring a checkpoint into a
+    buffer constructed with a DIFFERENT seed must replay the exact index
+    stream the saved run would have drawn next."""
+    def make(seed):
+        rb = ReplayBuffer(16, n_envs=2, obs_keys=("obs",), seed=seed)
+        rb.add(_rows(rb, 12, 2))
+        return rb
+
+    rb1 = make(seed=5)
+    rb1.sample(4)  # advance the stream past its initial state
+    state = rb1.checkpoint_state_dict()
+    expect_idx = rb1.sample_indices(8)
+
+    rb2 = make(seed=999)  # ctor seed must NOT matter after restore
+    rb2.load_state_dict(state)
+    got_idx = rb2.sample_indices(8)
+    for a, b in zip(expect_idx, got_idx):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_env_independent_sequential_resume_replays_identical_batch():
+    """EnvIndependent/sequential (the Dreamer replay path): same checkpoint ⇒
+    bit-identical first resumed batch, including the cross-env multinomial."""
+    def make(seed):
+        rb = EnvIndependentReplayBuffer(
+            16, n_envs=2, obs_keys=("obs",), buffer_cls=SequentialReplayBuffer, seed=seed
+        )
+        for i in range(12):
+            rb.add(_rows(rb, 1, 2, mark=float(i)))
+        return rb
+
+    rb1 = make(seed=5)
+    rb1.sample(4, sequence_length=3)
+    # raw state (no truncated-flag surgery — that intentional one-flag edit
+    # is covered above): this asserts the SAMPLE STREAM itself round-trips
+    state = rb1.state_dict()
+    expect = rb1.sample(4, sequence_length=3)
+
+    rb2 = make(seed=999)
+    rb2.load_state_dict(state)
+    got = rb2.sample(4, sequence_length=3)
+    for k in expect:
+        np.testing.assert_array_equal(expect[k], got[k])
+
+
+def test_episode_buffer_rng_rides_the_checkpoint():
+    def make(seed):
+        eb = EpisodeBuffer(64, n_envs=1, obs_keys=("obs",), seed=seed)
+        for i in range(3):
+            rows = _rows(eb, 8, 1, mark=float(i))
+            rows["terminated"][-1] = 1.0
+            eb.add(rows)
+        return eb
+
+    eb1 = make(seed=5)
+    eb1.sample(2, sequence_length=4)
+    state = eb1.checkpoint_state_dict()
+    expect = eb1.sample(2, sequence_length=4)
+
+    eb2 = make(seed=999)
+    eb2.load_state_dict(state)
+    got = eb2.sample(2, sequence_length=4)
+    for k in expect:
+        np.testing.assert_array_equal(expect[k], got[k])
