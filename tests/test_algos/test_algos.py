@@ -89,11 +89,14 @@ def test_dreamer_v3(standard_args, env_id):
     )
 
 
-def test_dreamer_v3_device_ring(standard_args):
+def test_dreamer_v3_device_ring(standard_args, devices):
     """HBM-resident replay ring (buffer.device_cache=true forces it on the
-    CPU backend): the bench-critical path where batches gather on device."""
+    CPU backend): the bench-critical path where batches gather on device.
+    devices=2 exercises the dp-SHARDED ring (per-device env sub-rings,
+    batches assembled pre-sharded — VERDICT r4 #3)."""
     _run(
         [
+            f"fabric.devices={devices}",
             "exp=dreamer_v3",
             "env=dummy",
             "env.id=discrete_dummy",

@@ -56,11 +56,10 @@ def test_resumed_sequential_sample_never_spans_save_discontinuity():
     rb = SequentialReplayBuffer(64, n_envs=1)
     rb.add(_rows(rb, 10, 1, mark=1.0))  # pre-save data, episode still open
 
-    resumed = SequentialReplayBuffer(64, n_envs=1)
+    resumed = SequentialReplayBuffer(64, n_envs=1, seed=0)
     resumed.load_state_dict(rb.checkpoint_state_dict())
     resumed.add(_rows(rb, 10, 1, mark=2.0))  # post-resume data (env was reset)
 
-    np.random.seed(0)
     for _ in range(50):
         batch = resumed.sample(8, sequence_length=5)  # [n_samples=1, L, B, 1]
         obs = batch["obs"][0, :, :, 0].T  # [B, L]

@@ -221,7 +221,9 @@ def test_uniform_next_obs_parity():
     assert batch["next_rgb"].dtype == np.uint8
 
 
-def test_forced_ring_rejects_multidevice_mesh():
+def test_forced_ring_rejects_multidevice_mesh_on_uniform_path():
+    """The uniform (SAC-family) ring is still single-device; only the
+    sequential path shards over dp (multi_ok)."""
     from sheeprl_tpu.data.device_ring import _use_ring
 
     class _Cfg:
@@ -232,8 +234,9 @@ def test_forced_ring_rejects_multidevice_mesh():
         world_size = 2
         local_device = None
 
-    with pytest.raises(ValueError, match="single-device mesh"):
+    with pytest.raises(ValueError, match="single-device on this replay path"):
         _use_ring(_Cfg(), _Dist(), 100, 10)
+    assert _use_ring(_Cfg(), _Dist(), 100, 10, multi_ok=True)
 
 
 def test_uniform_wraparound_and_backlog():
@@ -246,3 +249,87 @@ def test_uniform_wraparound_and_backlog():
     ring_host = {k: np.asarray(v) for k, v in ring.ring.items()}
     np.testing.assert_array_equal(ring_host["state"], rb["state"])
     np.testing.assert_array_equal(ring_host["rgb"], rb["rgb"])
+
+
+# -- dp-sharded ring (multi-device meshes, VERDICT r4 #3) ---------------------
+
+
+def _sharded_make(n_devices=2, n_envs=4, batch=4, size=32):
+    from sheeprl_tpu.data.device_ring import ShardedDeviceRingPrefetcher
+    from sheeprl_tpu.parallel import Distributed
+
+    dist = Distributed(devices=n_devices)
+    rb = EnvIndependentReplayBuffer(
+        size, n_envs=n_envs, obs_keys=KEYS, buffer_cls=SequentialReplayBuffer, seed=3
+    )
+    ring = ShardedDeviceRingPrefetcher(
+        rb, batch_size=batch, sequence_length=5, cnn_keys=("rgb",), dist=dist
+    )
+    return rb, ring, dist
+
+
+def _row_per_env(t, n_envs):
+    """Row whose content encodes (t, env) per COLUMN: state = 1000*t + env."""
+    row = _row(t, 0, n_envs)
+    row["state"] = (
+        1000.0 * t + np.arange(n_envs, dtype=np.float32)[None, :, None] * np.ones((1, n_envs, 3), np.float32)
+    ).astype(np.float32)
+    row["rgb"] = (
+        (7 * t + np.arange(n_envs, dtype=np.uint8)[None, :, None, None, None]) % 251
+        * np.ones((1, n_envs, 4, 4, 3), np.uint8)
+    ).astype(np.uint8)
+    return row
+
+
+def test_sharded_gather_matches_host_rows():
+    """Each batch column must be a true window of the env sub-buffer the
+    owning device mirrors — bit-identical to the host arrays."""
+    rb, ring, dist = _sharded_make()
+    for t in range(20):
+        rb.add(_row_per_env(t, 4))
+    batch = ring.take(2)
+    assert batch["rgb"].shape[:3] == (2, 5, 4)
+    # batches land dp-sharded over the batch axis with no collectives
+    assert batch["rgb"].sharding.spec == jax.sharding.PartitionSpec(None, None, "dp")
+    # column c of gather g: env + window start recoverable from the content
+    host = np.asarray(batch["state"])  # state = 1000*t + env
+    for g in range(2):
+        for c in range(4):
+            env = int(host[g, 0, c, 0] % 1000)
+            # device d owns envs [d*2, d*2+2): column c belongs to device c//2
+            assert env // 2 == c // 2, (env, c)
+            t0 = int(host[g, 0, c, 0] // 1000)
+            expect = _host_window(rb, env, t0, 5, "state")
+            np.testing.assert_array_equal(np.asarray(batch["state"])[g, :, c], expect)
+            np.testing.assert_array_equal(
+                np.asarray(batch["rgb"])[g, :, c], _host_window(rb, env, t0, 5, "rgb")
+            )
+
+
+def test_sharded_incremental_sync_and_f32_casts():
+    rb, ring, dist = _sharded_make()
+    for t in range(12):
+        rb.add(_row_per_env(t, 4))
+    b1 = ring.take(1)
+    assert b1["rewards"].dtype == np.float32
+    assert b1["rgb"].dtype == np.uint8  # images stay uint8 in HBM and batch
+    for t in range(12, 30):  # wrap around
+        rb.add(_row_per_env(t, 4))
+    b2 = ring.take(1)
+    host = np.asarray(b2["state"])
+    for c in range(4):
+        t0 = int(host[0, 0, c, 0] // 1000)
+        env = int(host[0, 0, c, 0] % 1000)
+        np.testing.assert_array_equal(host[0, :, c], _host_window(rb, env, t0, 5, "state"))
+
+
+def test_sharded_requires_divisible_sizes():
+    from sheeprl_tpu.data.device_ring import ShardedDeviceRingPrefetcher
+    from sheeprl_tpu.parallel import Distributed
+
+    dist = Distributed(devices=2)
+    rb = EnvIndependentReplayBuffer(
+        16, n_envs=3, obs_keys=KEYS, buffer_cls=SequentialReplayBuffer
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        ShardedDeviceRingPrefetcher(rb, 4, 2, dist=dist)

@@ -48,7 +48,7 @@ def test_eviction():
 
 
 def test_sample_shapes_and_windows():
-    eb = EpisodeBuffer(buffer_size=64, n_envs=1)
+    eb = EpisodeBuffer(buffer_size=64, n_envs=1, seed=1)
     eb.add(_steps(20, 1, done_at=19))
     out = eb.sample(6, sequence_length=5, n_samples=2)
     assert out["observations"].shape == (2, 5, 6, 1)
@@ -80,9 +80,8 @@ def test_multi_env_independent_open_episodes():
 
 
 def test_prioritize_ends_biases_final_windows():
-    eb = EpisodeBuffer(buffer_size=512, n_envs=1, prioritize_ends=True)
+    eb = EpisodeBuffer(buffer_size=512, n_envs=1, prioritize_ends=True, seed=0)
     eb.add(_steps(100, 1, done_at=99))
-    np.random.seed(0)
     out = eb.sample(256, sequence_length=10, n_samples=1)
     # with prioritize_ends the last window (ending at t=99) must be sampled
     # far more often than the 1/91 a uniform sampler would give it
@@ -99,10 +98,9 @@ def test_prioritize_ends_biases_final_windows():
 def test_state_dict_roundtrip_preserves_samples():
     eb = EpisodeBuffer(buffer_size=64, n_envs=1)
     eb.add(_steps(20, 1, done_at=19))
-    clone = EpisodeBuffer(buffer_size=64, n_envs=1)
+    clone = EpisodeBuffer(buffer_size=64, n_envs=1, seed=1)
     clone.load_state_dict(eb.state_dict())
     assert len(clone) == len(eb)
-    np.random.seed(1)
     a = clone.sample(4, sequence_length=5)
     assert a["observations"].shape == (1, 5, 4, 1)
 

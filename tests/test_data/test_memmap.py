@@ -48,7 +48,7 @@ def test_write_readback_and_dtype(tmp_path):
 def test_buffer_with_memmap_storage_roundtrip(tmp_path):
     from sheeprl_tpu.data import SequentialReplayBuffer
 
-    rb = SequentialReplayBuffer(32, n_envs=2, memmap=True, memmap_dir=tmp_path / "rb")
+    rb = SequentialReplayBuffer(32, n_envs=2, memmap=True, memmap_dir=tmp_path / "rb", seed=0)
     data = {
         "obs": np.arange(16, dtype=np.float32).reshape(8, 2, 1),
         "terminated": np.zeros((8, 2, 1), np.float32),
@@ -56,7 +56,6 @@ def test_buffer_with_memmap_storage_roundtrip(tmp_path):
     }
     rb.add(data)
     assert (tmp_path / "rb" / "obs.memmap").exists()
-    np.random.seed(0)
     out = rb.sample(4, sequence_length=3)
     assert out["obs"].shape == (1, 3, 4, 1)
     # sequential windows advance by one env-step (stride n_envs in flat value)

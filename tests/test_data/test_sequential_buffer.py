@@ -21,9 +21,8 @@ def test_sample_sequences_shape_and_contiguity():
 
 
 def test_sample_wrapped_sequences_never_cross_head():
-    rb = SequentialReplayBuffer(buffer_size=8, n_envs=1)
+    rb = SequentialReplayBuffer(buffer_size=8, n_envs=1, seed=1)
     rb.add(_mk_data(13, 1))  # pos=5, stored [8,9,10,11,12,5,6,7]
-    np.random.seed(1)
     out = rb.sample(64, sequence_length=3)
     seqs = out["observations"][0, ..., 0]  # [L, batch] → check contiguity
     diffs = np.diff(seqs, axis=0)
