@@ -585,8 +585,8 @@ def make_sequential_prefetcher(
             )
         else:
             msg = (
-                f"sharded device ring needs env.num_envs ({rb.n_envs}) and "
-                f"per_rank_batch_size ({batch_size}) divisible by the mesh size "
+                f"sharded device ring needs env.num_envs ({rb.n_envs}) and the "
+                f"global batch size ({batch_size}) divisible by the mesh size "
                 f"({dist.world_size})"
             )
         if _ring_mode(cfg) == "true":  # explicitly forced: fail loudly

@@ -46,7 +46,7 @@ def test_sample_shapes():
 
 
 def test_sample_empty_raises():
-    rb = ReplayBuffer(buffer_size=4, n_envs=1, seed=0)
+    rb = ReplayBuffer(buffer_size=4, n_envs=1)
     with pytest.raises(ValueError):
         rb.sample(1)
 
@@ -54,7 +54,7 @@ def test_sample_empty_raises():
 def test_sample_next_obs_never_crosses_write_head():
     """When full, the transition at pos-1 has its successor overwritten — it
     must never be sampled with sample_next_obs (reference buffers.py:249-252)."""
-    rb = ReplayBuffer(buffer_size=4, n_envs=1)
+    rb = ReplayBuffer(buffer_size=4, n_envs=1, seed=0)
     rb.add(_mk_data(6, 1))  # stored [4,5,2,3], pos=2 → invalid idx=1 (obs 5)
     for _ in range(20):
         out = rb.sample(16, sample_next_obs=True)

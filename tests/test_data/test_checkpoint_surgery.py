@@ -53,10 +53,11 @@ def test_resumed_sequential_sample_never_spans_save_discontinuity():
     resume, add more steps of the *new* episode, sample sequences — every
     sequence that crosses the save point must contain the truncated marker,
     so a consumer can see the discontinuity. Fails on a raw state_dict()."""
-    rb = SequentialReplayBuffer(64, n_envs=1)
+    rb = SequentialReplayBuffer(64, n_envs=1, seed=0)
     rb.add(_rows(rb, 10, 1, mark=1.0))  # pre-save data, episode still open
 
-    resumed = SequentialReplayBuffer(64, n_envs=1, seed=0)
+    # the checkpointed rng state (from the seeded source) governs resumed draws
+    resumed = SequentialReplayBuffer(64, n_envs=1)
     resumed.load_state_dict(rb.checkpoint_state_dict())
     resumed.add(_rows(rb, 10, 1, mark=2.0))  # post-resume data (env was reset)
 

@@ -87,3 +87,29 @@ def test_wall_clock_stopper_disabled_multi_host(monkeypatch, capsys):
     wall = WallClockStopper(Config({"algo": {"max_wall_time_s": 1}}))
     assert wall.max_s < 0  # rank-local clocks cannot coordinate a stop
     assert not wall.expired(0, 100)
+
+
+@pytest.mark.slow
+def test_real_two_process_multihost_dryrun():
+    """No mocks: two actual controller processes jax.distributed.initialize
+    against a local coordinator and run the cross-process psum / ZeRO-1 /
+    allgather-checkpoint suite (scripts/multihost_dryrun.py, VERDICT r4 #4).
+    The monkeypatch-based tests above stay as fast unit coverage of the same
+    rank-gating logic."""
+    import json
+    import os
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(repo, "scripts", "multihost_dryrun.py")],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        timeout=300,
+        cwd=repo,
+    )
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert proc.returncode == 0 and rec["ok"], rec
+    assert rec["n_processes"] == 2
