@@ -210,23 +210,36 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
 
 
 def registration(args: Optional[Sequence[str]] = None) -> None:
-    """`sheeprl_tpu registration checkpoint_path=...` — register a trained
-    model in the local model registry (reference cli.py:408-450, MLflow
-    replaced by the file-based registry in utils/model_manager.py)."""
+    """`sheeprl_tpu registration checkpoint_path=... [backend=mlflow]` —
+    register a trained model, split per the algo's MODELS_TO_REGISTER
+    (reference cli.py:408-450). Default backend is the local file registry
+    (utils/model_manager.py); `backend=mlflow` publishes to a remote MLflow
+    registry instead (utils/mlflow_registry.py — needs the mlflow package
+    and MLFLOW_TRACKING_URI, like the reference's utils/mlflow.py)."""
     argv = list(args if args is not None else sys.argv[1:])
     import sheeprl_tpu  # ensure registries are populated
     from .utils.model_manager import register_models_from_checkpoint
 
     ckpt: Optional[str] = None
+    backend = "local"
     rest: List[str] = []
     for a in argv:
         if a.startswith("checkpoint_path="):
             ckpt = a.split("=", 1)[1]
+        elif a.startswith("backend="):
+            backend = a.split("=", 1)[1]
         else:
             rest.append(a)
     if ckpt is None:
         raise ValueError("registration requires `checkpoint_path=<path to .ckpt>`")
-    register_models_from_checkpoint(pathlib.Path(ckpt), rest)
+    if backend == "mlflow":
+        from .utils.mlflow_registry import register_models_from_checkpoint_remote
+
+        register_models_from_checkpoint_remote(pathlib.Path(ckpt))
+    elif backend == "local":
+        register_models_from_checkpoint(pathlib.Path(ckpt), rest)
+    else:
+        raise ValueError(f"Unknown registration backend '{backend}' (local | mlflow)")
 
 
 def available_agents() -> None:

@@ -1,0 +1,273 @@
+"""Remote (MLflow) model-registry lifecycle.
+
+Counterpart of reference sheeprl/utils/mlflow.py:75-427
+(`MlflowModelManager.register_model / get_latest_version / transition_model /
+delete_model / register_best_models / download_model`). The local file
+registry (utils/model_manager.py) stays the default; this backend activates
+only when the `mlflow` package is importable AND a tracking URI is
+configured (`MLFLOW_TRACKING_URI` or an explicit argument) — e.g.
+``sheeprl_tpu registration checkpoint_path=... backend=mlflow``.
+
+Framework-idiomatic differences from the reference:
+* models are JAX param pytrees, published as pickled-numpy artifacts
+  (``<model>/params.pkl``) of an MLflow run, then registered from that
+  run's artifact URI — no torch/Fabric module wrappers;
+* ``delete_model`` takes ``assume_yes`` instead of the reference's
+  interactive ``input()`` prompt (headless CLI / CI friendly; the prompt
+  remains the default behavior when stdin is a tty);
+* the same MODELS_TO_REGISTER split drives which checkpoint pieces publish
+  (a DreamerV3 checkpoint → world_model / actor / critic / target_critic /
+  moments versions, utils/model_manager.py:_models_to_register).
+
+The MODEL CHANGELOG markdown convention (version / transition / deletion
+entries appended to both the registered model and the version description)
+matches the reference so registries written by either are readable by both.
+"""
+from __future__ import annotations
+
+import getpass
+import os
+import pathlib
+import pickle
+import sys
+import tempfile
+from datetime import datetime
+from typing import Any, Dict, Literal, Optional, Sequence
+
+import jax
+import numpy as np
+
+VERSION_MD_TEMPLATE = "## **Version {}**\n"
+DESCRIPTION_MD_TEMPLATE = "### Description: \n{}\n"
+
+
+def _require_mlflow():
+    import mlflow  # gated: raises ModuleNotFoundError when not installed
+
+    return mlflow
+
+
+def author_and_date_md() -> str:
+    """Changelog entry attribution block (reference mlflow.py:304-310)."""
+    stamp = datetime.now().astimezone().strftime("%d/%m/%Y %H:%M:%S %Z")
+    return f"### Author: {getpass.getuser()}\n### Date: {stamp}\n"
+
+
+def description_md(description: Optional[str]) -> str:
+    return "" if description is None else DESCRIPTION_MD_TEMPLATE.format(description)
+
+
+class MlflowModelManager:
+    """Remote model lifecycle over an MLflow tracking server."""
+
+    def __init__(self, tracking_uri: Optional[str] = None):
+        mlflow = _require_mlflow()
+        self.tracking_uri = tracking_uri or os.getenv("MLFLOW_TRACKING_URI")
+        if not self.tracking_uri:
+            raise ValueError(
+                "No MLflow tracking URI: pass tracking_uri= or set MLFLOW_TRACKING_URI"
+            )
+        mlflow.set_tracking_uri(self.tracking_uri)
+        self._mlflow = mlflow
+        self.client = mlflow.tracking.MlflowClient()
+
+    # -- lifecycle ---------------------------------------------------------
+    def register_model(
+        self,
+        model_location: str,
+        model_name: str,
+        description: Optional[str] = None,
+        tags: Optional[Dict[str, Any]] = None,
+    ):
+        """Register `model_location` (an artifact/run URI) as a new version
+        of `model_name`, appending a MODEL CHANGELOG entry to both the
+        registered model and the version (reference mlflow.py:89-123)."""
+        version = self._mlflow.register_model(model_uri=model_location, name=model_name, tags=tags)
+        print(f"Registered model {model_name} with version {version.version}")
+        current = self.client.get_registered_model(model_name).description or ""
+        header = "# MODEL CHANGELOG\n" if str(version.version) == "1" else ""
+        entry = VERSION_MD_TEMPLATE.format(version.version) + author_and_date_md() + description_md(description)
+        self.client.update_registered_model(model_name, header + current + entry)
+        self.client.update_model_version(model_name, version.version, "# MODEL CHANGELOG\n" + entry)
+        return version
+
+    def get_latest_version(self, model_name: str):
+        versions = self.client.get_latest_versions(model_name)
+        if not versions:
+            raise LookupError(f"Model '{model_name}' has no registered versions")
+        return self.client.get_model_version(model_name, max(int(v.version) for v in versions))
+
+    def transition_model(
+        self,
+        model_name: str,
+        version: int,
+        stage: str,
+        description: Optional[str] = None,
+    ):
+        """Move a version between stages, recording the transition in both
+        changelogs (reference mlflow.py:139-177)."""
+        previous = self._safe_get_stage(model_name, version)
+        if previous is None:
+            return None
+        if previous.lower() == str(stage).lower():
+            print(f"Model {model_name} version {version} is already in stage {stage}")
+            return self.client.get_model_version(model_name, version)
+        print(f"Transitioning model {model_name} version {version} from {previous} to {stage}")
+        mv = self.client.transition_model_version_stage(name=model_name, version=version, stage=stage)
+        entry = (
+            "## **Transition:**\n"
+            f"### Version {mv.version} from {previous} to {mv.current_stage}\n"
+            + author_and_date_md()
+            + description_md(description)
+        )
+        self.client.update_registered_model(
+            model_name, (self.client.get_registered_model(model_name).description or "") + entry
+        )
+        self.client.update_model_version(
+            model_name, mv.version, (self.client.get_model_version(model_name, version).description or "") + entry
+        )
+        return mv
+
+    def delete_model(
+        self,
+        model_name: str,
+        version: int,
+        description: Optional[str] = None,
+        assume_yes: bool = False,
+    ) -> None:
+        """Delete one version; interactive name confirmation like the
+        reference (mlflow.py:179-214) unless `assume_yes` or non-tty."""
+        stage = self._safe_get_stage(model_name, version)
+        if stage is None:
+            return
+        if not assume_yes and sys.stdin.isatty():
+            typed = input(
+                f"Model named `{model_name}`, version {version} is in stage {stage}, "
+                "type the model name to continue deletion:"
+            )
+            if typed != model_name:
+                print("Model name did not match, aborting deletion")
+                return
+        print(f"Deleting model {model_name} version {version}")
+        self.client.delete_model_version(model_name, version)
+        entry = (
+            "## **Deletion:**\n"
+            f"### Version {version} from stage: {stage}\n"
+            + author_and_date_md()
+            + description_md(description)
+        )
+        self.client.update_registered_model(
+            model_name, (self.client.get_registered_model(model_name).description or "") + entry
+        )
+
+    def register_best_models(
+        self,
+        experiment_name: str,
+        models_info: Dict[str, Dict[str, Any]],
+        metric: str = "Test/cumulative_reward",
+        mode: Literal["max", "min"] = "max",
+    ):
+        """Register every configured model of the experiment run that scored
+        best on `metric` (reference mlflow.py:216-280)."""
+        if mode not in ("max", "min"):
+            raise ValueError(f"Mode must be either 'max' or 'min', got {mode}")
+        exp = self.client.get_experiment_by_name(experiment_name)
+        runs = self.client.search_runs(experiment_ids=[exp.experiment_id]) if exp else []
+        paths = [v["path"] for v in models_info.values()]
+        best, best_artifacts = None, None
+        for run in runs:
+            arts = [a.path for a in self.client.list_artifacts(run.info.run_id) if a.path in paths]
+            if not arts or run.data.metrics.get(metric) is None:
+                continue
+            if best is None or (
+                run.data.metrics[metric] > best.data.metrics[metric]
+                if mode == "max"
+                else run.data.metrics[metric] < best.data.metrics[metric]
+            ):
+                best, best_artifacts = run, set(arts)
+        if best is None:
+            print(f"No runs found for experiment {experiment_name} with the given metric")
+            return None
+        out = {}
+        for key, info in models_info.items():
+            if info["path"] in best_artifacts:
+                out[key] = self.register_model(
+                    f"runs:/{best.info.run_id}/{info['path']}",
+                    info["name"],
+                    description=info.get("description"),
+                    tags=info.get("tags"),
+                )
+        return out
+
+    def download_model(self, model_name: str, version: int, output_path: str) -> None:
+        """Fetch a version's artifacts to `output_path` (mlflow.py:282-296)."""
+        uri = self.client.get_model_version_download_uri(model_name, version)
+        print(f"Downloading model {model_name} version {version} from {uri} to {output_path}")
+        os.makedirs(output_path, exist_ok=True)
+        self._mlflow.artifacts.download_artifacts(artifact_uri=uri, dst_path=output_path)
+
+    # -- helpers -----------------------------------------------------------
+    def _safe_get_stage(self, model_name: str, version: int) -> Optional[str]:
+        try:
+            return self.client.get_model_version(model_name, version).current_stage
+        except Exception:
+            print(f"Model named {model_name} with version {version} does not exist")
+            return None
+
+
+def publish_params(manager: MlflowModelManager, run_name: str, models: Dict[str, Any],
+                   specs: Optional[Dict[str, Dict[str, Any]]] = None,
+                   experiment_name: str = "sheeprl_tpu") -> Dict[str, Any]:
+    """Log each params pytree as a pickled artifact of ONE new MLflow run and
+    register each as a model version. Returns {name: ModelVersion}."""
+    mlflow = manager._mlflow
+    exp = mlflow.get_experiment_by_name(experiment_name)
+    exp_id = mlflow.create_experiment(experiment_name) if exp is None else exp.experiment_id
+    versions: Dict[str, Any] = {}
+    with mlflow.start_run(experiment_id=exp_id, run_name=run_name) as run:
+        with tempfile.TemporaryDirectory() as td:
+            for name, params in models.items():
+                host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), params)
+                sub = pathlib.Path(td) / name
+                sub.mkdir()
+                with open(sub / "params.pkl", "wb") as f:
+                    pickle.dump(host, f)
+                mlflow.log_artifacts(str(sub), artifact_path=name)
+        for name in models:
+            spec = (specs or {}).get(name, {})
+            versions[name] = manager.register_model(
+                f"runs:/{run.info.run_id}/{name}",
+                spec.get("model_name", name),
+                description=spec.get("description"),
+                tags=spec.get("tags"),
+            )
+    return versions
+
+
+def register_models_from_checkpoint_remote(ckpt_path: pathlib.Path) -> None:
+    """Remote twin of model_manager.register_models_from_checkpoint: split
+    the checkpoint per the algo's MODELS_TO_REGISTER and publish each piece
+    to the MLflow registry (reference cli.py registration → mlflow.py)."""
+    from ..config import load_config_file
+    from .checkpoint import CheckpointManager
+    from .model_manager import _models_to_register, _resolve_model
+
+    manager = MlflowModelManager()  # fail fast, before the (large) ckpt load
+    cfg = load_config_file(ckpt_path.parent.parent / "config.yaml")
+    state = CheckpointManager.load(ckpt_path)
+    algo_name = str(cfg.select("algo.name"))
+    prefix = f"{algo_name}_{cfg.select('env.id')}"
+    names = _models_to_register(algo_name)
+    models: Dict[str, Any] = {}
+    if names:
+        for name in names:
+            value = _resolve_model(name, state)
+            if value is None:
+                print(f"[registration] '{name}' not found in checkpoint {ckpt_path}; skipped")
+                continue
+            models[f"{prefix}_{name}"] = value
+    else:
+        models = {
+            f"{prefix}_{k}": v for k, v in state.items() if k.endswith("params") and v is not None
+        }
+    publish_params(manager, run_name=prefix, models=models, experiment_name=str(cfg.select("exp_name") or prefix))

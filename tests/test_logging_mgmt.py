@@ -175,3 +175,30 @@ def test_unknown_logger_errors():
     cfg = compose("config", ["exp=ppo", "env=dummy", "metric.logger=nope"])
     with pytest.raises(ValueError, match="metric.logger"):
         get_logger(cfg, "/tmp/x")
+
+
+def test_mlflow_registry_helpers_and_gating(monkeypatch):
+    """The remote registry surface is importable without mlflow; its manager
+    raises the gated ModuleNotFoundError (or, with mlflow installed but no
+    tracking URI, a ValueError) at USE time, and the changelog helpers match
+    the reference markdown conventions."""
+    from sheeprl_tpu.utils.mlflow_registry import (
+        MlflowModelManager,
+        author_and_date_md,
+        description_md,
+    )
+
+    md = author_and_date_md()
+    assert md.startswith("### Author: ") and "### Date: " in md
+    assert description_md(None) == ""
+    assert description_md("hello") == "### Description: \nhello\n"
+    monkeypatch.delenv("MLFLOW_TRACKING_URI", raising=False)  # isolate the env fallback
+    with pytest.raises((ModuleNotFoundError, ValueError)):
+        MlflowModelManager(tracking_uri=None)
+
+
+def test_registration_cli_rejects_unknown_backend(tmp_path):
+    from sheeprl_tpu.cli import registration
+
+    with pytest.raises(ValueError, match="Unknown registration backend"):
+        registration([f"checkpoint_path={tmp_path}/x.ckpt", "backend=nope"])

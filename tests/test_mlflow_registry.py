@@ -1,0 +1,54 @@
+"""Remote (MLflow) model-registry lifecycle — utils/mlflow_registry.py.
+
+The full lifecycle tests are GATED like the reference's run_tests_mlflow.py:
+they need the `mlflow` package and a reachable MLFLOW_TRACKING_URI; without
+either they skip. The pure helpers (changelog markdown, CLI routing) run
+everywhere.
+"""
+import os
+
+import numpy as np
+import pytest
+
+mlflow = pytest.importorskip("mlflow", reason="mlflow not installed (gated backend)")
+
+pytestmark = pytest.mark.skipif(
+    not os.getenv("MLFLOW_TRACKING_URI"),
+    reason="MLFLOW_TRACKING_URI not set (needs a tracking server, like reference run_tests_mlflow.py)",
+)
+
+
+@pytest.fixture()
+def manager():
+    from sheeprl_tpu.utils.mlflow_registry import MlflowModelManager
+
+    return MlflowModelManager()
+
+
+def test_register_transition_download_delete_roundtrip(manager, tmp_path):
+    from sheeprl_tpu.utils.mlflow_registry import publish_params
+
+    params = {"dense": {"kernel": np.ones((4, 4), np.float32)}}
+    name = f"sheeprl-tpu-test-{os.getpid()}"
+    versions = publish_params(manager, "pytest-run", {name: params})
+    v = int(versions[name].version)
+
+    latest = manager.get_latest_version(name)
+    assert int(latest.version) == v
+    assert "MODEL CHANGELOG" in (manager.client.get_registered_model(name).description or "")
+
+    mv = manager.transition_model(name, v, "Staging", description="promote for test")
+    assert mv.current_stage == "Staging"
+
+    out = tmp_path / "dl"
+    manager.download_model(name, v, str(out))
+    import pickle
+
+    blobs = list(out.rglob("params.pkl"))
+    assert blobs, "downloaded artifacts must include params.pkl"
+    loaded = pickle.load(open(blobs[0], "rb"))
+    np.testing.assert_array_equal(loaded["dense"]["kernel"], params["dense"]["kernel"])
+
+    manager.delete_model(name, v, description="cleanup", assume_yes=True)
+    with pytest.raises(Exception):
+        manager.client.get_model_version(name, v)

@@ -107,9 +107,10 @@ def test_real_two_process_multihost_dryrun():
         stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL,
         text=True,
-        timeout=300,
+        timeout=600,  # > the parent's own 2 sequential 240s child budgets
         cwd=repo,
     )
+    assert proc.stdout.strip(), f"parent printed nothing (rc={proc.returncode})"
     rec = json.loads(proc.stdout.strip().splitlines()[-1])
     assert proc.returncode == 0 and rec["ok"], rec
     assert rec["n_processes"] == 2
