@@ -1,0 +1,101 @@
+"""Template for a decoupled player/trainer architecture on this framework
+(counterpart of reference examples/architecture_template.py, 195 LoC).
+
+The reference spawns buffer/player/trainer PROCESSES wired with torch
+collectives (gather/broadcast over gloo). The TPU-native shape is different
+and this template shows it:
+
+* the TRAINER is the main thread: one donated, jitted update over the
+  device mesh (dp-sharded batches) — XLA collectives replace the hand-run
+  parameter broadcasts;
+* PLAYERS are host threads stepping envs with a host-committed param
+  MIRROR (parallel/placement.py pattern): refreshing the mirror replaces
+  the reference's players_trainer_collective.broadcast;
+* the BUFFER is a thread-safe queue between them: queue.put replaces
+  buffer_players_collective.gather.
+
+This is exactly how ppo_decoupled.py / sac_decoupled.py are built; the toy
+below is self-contained (a linear "policy" on random data) so it runs in
+seconds on CPU: `python examples/architecture_template.py`.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+NUM_PLAYERS = 2
+ROLLOUTS_PER_PLAYER = 4
+BATCH = 32
+OBS_DIM = 8
+
+
+def player(rank: int, rollouts: queue.Queue, mirror: dict, stop: threading.Event) -> None:
+    """Collect trajectories with the CURRENT mirrored params and hand them
+    to the buffer queue (the reference's gather_object)."""
+    rng = np.random.default_rng(rank)
+    for it in range(ROLLOUTS_PER_PLAYER):
+        if stop.is_set():
+            return
+        w = mirror["w"]  # latest trainer-refreshed params, host-committed
+        obs = rng.standard_normal((BATCH, OBS_DIM)).astype(np.float32)
+        # toy "environment": reward is higher when action tracks obs @ w_true
+        actions = obs @ np.asarray(w)
+        targets = obs @ np.linspace(1, 2, OBS_DIM).astype(np.float32)
+        rollouts.put({"obs": obs, "actions": actions, "targets": targets})
+        print(f"[player {rank}] rollout {it} collected")
+
+
+def main() -> None:
+    # jitted trainer update: one donated XLA program — on a real mesh the
+    # batch would be dp-sharded and XLA would insert the gradient psum
+    tx = optax.sgd(1e-1)
+
+    @jax.jit
+    def update(w, opt_state, batch):
+        def loss_fn(w):
+            pred = batch["obs"] @ w
+            return jnp.mean((pred - batch["targets"]) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(w)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(w, updates), opt_state, loss
+
+    w = jnp.zeros((OBS_DIM,), jnp.float32)
+    opt_state = tx.init(w)
+    mirror = {"w": np.asarray(w)}  # host-side param mirror the players read
+    rollouts: queue.Queue = queue.Queue(maxsize=NUM_PLAYERS * 2)
+    stop = threading.Event()
+
+    threads = [
+        threading.Thread(target=player, args=(r, rollouts, mirror, stop), daemon=True)
+        for r in range(NUM_PLAYERS)
+    ]
+    for t in threads:
+        t.start()
+
+    total = NUM_PLAYERS * ROLLOUTS_PER_PLAYER
+    for step in range(total):
+        batch = rollouts.get()  # the buffer: gather from whichever player is ready
+        w, opt_state, loss = update(w, opt_state, batch)
+        mirror["w"] = np.asarray(w)  # broadcast replacement: refresh the mirror
+        print(f"[trainer] step {step}: loss {float(loss):.4f}")
+
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    final_err = float(jnp.abs(w - jnp.linspace(1, 2, OBS_DIM)).max())
+    print(f"[trainer] done; max |w - w_true| = {final_err:.3f}")
+    assert final_err < 0.5, "the toy trainer should approach w_true"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Model-manager lifecycle walkthrough (script equivalent of reference
+examples/model_manager.ipynb): train a tiny PPO run, register its
+checkpoint models, then exercise version / transition / download / delete.
+
+    python examples/model_manager_demo.py          # ~1 min on CPU
+
+Uses the default LOCAL registry; with mlflow + MLFLOW_TRACKING_URI the same
+flow works against the remote registry (`backend=mlflow`, see
+howto/model_manager.md)."""
+from __future__ import annotations
+
+import glob
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.cli import registration, run
+from sheeprl_tpu.utils.model_manager import ModelManager
+
+
+def main() -> None:
+    print("== 1. train a tiny PPO run (dry_run: one update) ==")
+    run(
+        [
+            "exp=ppo",
+            "dry_run=True",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "buffer.memmap=False",
+            "metric.log_level=0",
+        ]
+    )
+    ckpt = sorted(
+        glob.glob("logs/runs/ppo/CartPole-v1/*/version_*/checkpoint/ckpt_*.ckpt"),
+        key=os.path.getmtime,
+    )[-1]
+    print(f"checkpoint: {ckpt}")
+
+    print("\n== 2. register the checkpoint (split per MODELS_TO_REGISTER) ==")
+    registration([f"checkpoint_path={ckpt}"])
+
+    mm = ModelManager()  # models_registry/
+    name = "ppo_CartPole-v1_agent"
+    print("\n== 3. lifecycle ==")
+    print("latest version:", mm.get_latest_version(name))
+    params = mm.download_model(name)
+    print("downloaded params tree keys:", sorted(params.keys()))
+    mm.transition_model(name, 1, "production")
+    meta = pathlib.Path(f"models_registry/{name}/v1/meta.json").read_text()
+    print("v1 meta after transition:", meta)
+    mm.delete_model(name, version=1)
+    print("deleted v1; latest now:", mm.get_latest_version(name))
+
+
+if __name__ == "__main__":
+    main()

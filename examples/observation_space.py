@@ -1,0 +1,44 @@
+"""Print the observation space an agent will see for a given env config
+(counterpart of reference examples/observation_space.py, hydra CLI →
+the framework's own compose engine).
+
+    python examples/observation_space.py agent=dreamer_v3 env=dummy env.id=discrete_dummy
+    python examples/observation_space.py agent=ppo env=gym env.id=CartPole-v1
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.config import compose
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.registry import algorithm_registry
+
+
+def main(argv) -> None:
+    import sheeprl_tpu  # populate the algorithm registry
+
+    agent = "ppo"
+    overrides = []
+    for a in argv:
+        if a.startswith("agent="):
+            agent = a.split("=", 1)[1]
+        else:
+            overrides.append(a)
+    if agent not in algorithm_registry:
+        raise ValueError(
+            f"Invalid agent '{agent}': check the available agents with `python -m sheeprl_tpu agents`"
+        )
+    cfg = compose("config", [f"exp={agent}"] + overrides + ["env.capture_video=False"])
+    env = make_env(cfg, cfg.seed, 0)()
+    print(f"\nObservation space of `{cfg.env.id}` for the `{agent}` agent:")
+    print(env.observation_space)
+    print("\nAction space:")
+    print(env.action_space)
+    env.close()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,40 @@
+"""Replay-ratio bookkeeping demo (counterpart of reference
+examples/ratio.py): how `Ratio` converts policy steps into per-rank
+gradient-step repeats, and how the realized ratio converges to the
+configured one. Run: `python examples/ratio.py`."""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.utils.utils import Ratio
+
+if __name__ == "__main__":
+    num_envs = 1
+    world_size = 1
+    replay_ratio = 0.0625  # the DreamerV3 benchmark recipe's value
+    per_rank_batch_size = 16
+    per_rank_sequence_length = 64
+    learning_starts = 128
+    total_policy_steps = 2**10
+
+    ratio = Ratio(replay_ratio, pretrain_steps=0)
+    replayed_frames = world_size * per_rank_batch_size * per_rank_sequence_length
+    gradient_steps = 0
+    policy_increment = num_envs * world_size
+    for step in range(0, total_policy_steps, policy_increment):
+        if step < learning_starts:
+            continue
+        repeats = ratio(step / world_size)
+        if repeats > 0:
+            print(
+                f"step {step}: {repeats} per-rank gradient repeats "
+                f"({repeats * world_size} global)"
+            )
+        gradient_steps += repeats * world_size
+
+    print("\nconfigured replay ratio:", replay_ratio)
+    print("Hafner 'train ratio' (ratio × replayed frames):", replay_ratio * replayed_frames)
+    print("realized ratio:", gradient_steps / total_policy_steps)
