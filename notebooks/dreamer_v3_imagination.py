@@ -30,8 +30,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-INITIAL_STEPS = int(os.environ.get("IMAG_INITIAL_STEPS", 24))
-IMAGINATION_STEPS = int(os.environ.get("IMAG_STEPS", 8))
+INITIAL_STEPS = max(2, int(os.environ.get("IMAG_INITIAL_STEPS", 24)))
+# the imagined window replays the tail of the observed one, so it can be at
+# most INITIAL_STEPS long (and must be at least 1)
+IMAGINATION_STEPS = min(max(1, int(os.environ.get("IMAG_STEPS", 8))), INITIAL_STEPS)
 
 _TINY = [
     "exp=dreamer_v3",
@@ -54,30 +56,47 @@ _TINY = [
 
 
 def load_or_build(ckpt_path):
-    """(cfg, wm, actor, params): from a checkpoint when given, else a fresh
-    tiny agent on the dummy env (smoke mode)."""
+    """(cfg, wm, actor, params, actions_dim, env): with a checkpoint, the
+    env is built from the checkpoint's own config (the reference notebook's
+    flow) so spaces/action dims match the trained kernels and the rollout
+    steps REAL frames; smoke mode uses a fresh tiny agent and synthetic
+    frames (env=None)."""
     from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
     from sheeprl_tpu.config import compose, load_config_file
     from sheeprl_tpu.parallel import Distributed
     from sheeprl_tpu.utils.checkpoint import CheckpointManager
+    from sheeprl_tpu.utils.env import make_env
 
-    state = None
+    state = env = None
     if ckpt_path is not None:
         cfg = load_config_file(ckpt_path.parent.parent / "config.yaml")
         state = CheckpointManager.load(ckpt_path)
+        cfg.set_path("env.num_envs", 1)
+        cfg.set_path("env.capture_video", False)
+        env = make_env(cfg, cfg.seed, 0)()
+        obs_space = env.observation_space
+        aspace = env.action_space
+        if isinstance(aspace, gym.spaces.Box):
+            actions_dim = list(aspace.shape)
+        elif isinstance(aspace, gym.spaces.MultiDiscrete):
+            actions_dim = aspace.nvec.tolist()
+        else:
+            actions_dim = [int(aspace.n)]
+        is_continuous = isinstance(aspace, gym.spaces.Box)
     else:
         print("[imagination] no checkpoint given: fresh tiny agent (smoke mode)")
         cfg = compose("config", _TINY)
+        obs_space = gym.spaces.Dict(
+            {"rgb": gym.spaces.Box(0, 255, tuple(cfg.env.screen_size for _ in range(2)) + (3,), np.uint8)}
+        )
+        actions_dim = [4]
+        is_continuous = False
     dist = Distributed(devices=1, precision="32-true")
-    obs_space = gym.spaces.Dict(
-        {"rgb": gym.spaces.Box(0, 255, tuple(cfg.env.screen_size for _ in range(2)) + (3,), np.uint8)}
-    )
-    actions_dim = [4]
     wm, actor, critic, params = build_agent(
-        dist, cfg, obs_space, actions_dim, False, jax.random.key(cfg.seed),
+        dist, cfg, obs_space, actions_dim, is_continuous, jax.random.key(cfg.seed),
         state["params"] if state else None,
     )
-    return cfg, wm, actor, params, actions_dim
+    return cfg, wm, actor, params, actions_dim, env
 
 
 def main() -> None:
@@ -89,7 +108,10 @@ def main() -> None:
     for a in sys.argv[1:]:
         if a.startswith("checkpoint_path="):
             ckpt = pathlib.Path(a.split("=", 1)[1])
-    cfg, wm, actor, params, actions_dim = load_or_build(ckpt)
+    cfg, wm, actor, params, actions_dim, env = load_or_build(ckpt)
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    obs_keys = cnn_keys + tuple(cfg.algo.mlp_keys.encoder)
+    frame_key = cnn_keys[0]
     side = int(cfg.env.screen_size)
     stoch_flat = int(cfg.algo.world_model.stochastic_size) * int(cfg.algo.world_model.discrete_size)
     R = int(cfg.algo.world_model.recurrent_model.recurrent_state_size)
@@ -97,19 +119,27 @@ def main() -> None:
     def wm_apply(method, *args):
         return wm.apply({"params": params["wm"]}, *args, method=method)
 
-    # ---- 1. roll the agent on synthetic frames, tracking posteriors ------
+    # ---- 1. roll the agent (real env when a checkpoint is given, else
+    # synthetic frames), tracking posteriors -------------------------------
     rng = np.random.default_rng(cfg.seed)
     key = jax.random.key(cfg.seed + 1)
     h = jnp.zeros((1, R))
     z = jnp.zeros((1, stoch_flat))
     a = jnp.zeros((1, sum(actions_dim)))
+    env_obs = env.reset(seed=cfg.seed)[0] if env is not None else None
     frames, hs, zs, acts = [], [], [], []
     for t in range(INITIAL_STEPS):
-        # a real run would step the env; synthetic frames keep this headless
-        frame = rng.integers(0, 255, (side, side, 3), np.uint8)
+        if env is not None:
+            obs_dict = {
+                k: jnp.asarray(np.asarray(env_obs[k], np.float32 if k not in cnn_keys else None))[None]
+                for k in obs_keys
+            }
+            frame = np.asarray(env_obs[frame_key])
+        else:
+            frame = rng.integers(0, 255, (side, side, 3), np.uint8)
+            obs_dict = {frame_key: jnp.asarray(frame)[None]}
         frames.append(frame)
-        obs = normalize_obs({"rgb": jnp.asarray(frame)[None]}, ("rgb",))
-        embedded = wm_apply(WorldModel.embed, obs)
+        embedded = wm_apply(WorldModel.embed, normalize_obs(obs_dict, cnn_keys))
         key, k_dyn, k_act = jax.random.split(key, 3)
         h, z, _, _ = wm_apply(
             WorldModel.dynamic, z, h, a, embedded,
@@ -118,13 +148,23 @@ def main() -> None:
         pre = actor.apply({"params": params["actor"]}, jnp.concatenate([z, h], -1))
         sampled, _ = sample_actor_actions(actor, pre, k_act)
         a = jnp.concatenate(sampled, -1)
+        if env is not None:
+            if isinstance(env.action_space, gym.spaces.Box):
+                env_action = np.asarray(sampled[0][0])
+            elif isinstance(env.action_space, gym.spaces.MultiDiscrete):
+                env_action = np.asarray([int(np.argmax(x[0])) for x in sampled])
+            else:
+                env_action = int(np.argmax(np.asarray(sampled[0][0])))
+            env_obs, _, terminated, truncated, _ = env.step(env_action)
+            if terminated or truncated:
+                env_obs = env.reset()[0]
         hs.append(h)
         zs.append(z)
         acts.append(a)
 
     # ---- 2. reconstruct the observed window from posteriors --------------
     latents = jnp.concatenate([jnp.stack(zs, 0), jnp.stack(hs, 0)], -1)  # [T, 1, Z+R]
-    recon = wm_apply(WorldModel.decode, latents)["rgb"]  # [T, 1, H, W, C], ~[-0.5, 0.5]
+    recon = wm_apply(WorldModel.decode, latents)[frame_key]  # [T, 1, H, W, C], ~[-0.5, 0.5]
     recon_frames = np.clip((np.asarray(recon[:, 0]) + 0.5) * 255, 0, 255).astype(np.uint8)
 
     # ---- 3. imagine forward from the midpoint ----------------------------
@@ -138,7 +178,7 @@ def main() -> None:
         sampled, _ = sample_actor_actions(actor, pre, k_act)
         a_i = jnp.concatenate(sampled, -1)
         imagined.append(jnp.concatenate([z_i, h_i], -1))
-    img = wm_apply(WorldModel.decode, jnp.stack(imagined, 0))["rgb"]
+    img = wm_apply(WorldModel.decode, jnp.stack(imagined, 0))[frame_key]
     img_frames = np.clip((np.asarray(img[:, 0]) + 0.5) * 255, 0, 255).astype(np.uint8)
 
     # ---- 4. write PNG strips + GIF ---------------------------------------

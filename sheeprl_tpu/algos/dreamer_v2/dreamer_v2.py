@@ -34,6 +34,7 @@ from ...data import (
 )
 from ...data.device_ring import estimate_row_bytes, make_sequential_prefetcher
 from ...distributions import Bernoulli, Independent, Normal
+from ...ops.transforms import unrolled_cumprod
 from ...optim import clipped
 from ...parallel import Distributed
 from ...parallel.placement import make_param_mirror, player_device
@@ -212,7 +213,7 @@ def make_train_fn(
                 bootstrap=target_values[-1], lmbda=lmbda,
             )
             discount = jax.lax.stop_gradient(
-                jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-1]], 0), 0)
+                unrolled_cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-1]], 0))
             )
             pre_dist = actor_apply(actor_params, jax.lax.stop_gradient(trajectories[:-2]))
             dists = dv2_actor_dists(actor, pre_dist)

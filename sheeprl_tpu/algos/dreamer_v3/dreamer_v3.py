@@ -40,6 +40,7 @@ from ...distributions import (
 )
 from ...ops import lambda_values as lambda_values_op
 from ...ops import pallas_gru as pg
+from ...ops.transforms import unrolled_cumprod
 from ...optim import clipped
 from ...parallel import Distributed
 from ...parallel.mesh import maybe_shard_opt_state
@@ -309,7 +310,7 @@ def make_train_fn(
             continues = jnp.concatenate([true_continue0[None], continues[1:]], axis=0)
             lv = lambda_values_op(rewards_img[1:], values[1:], continues[1:] * gamma, lmbda)
             discount = jax.lax.stop_gradient(
-                jnp.cumprod(continues * gamma, axis=0) / gamma
+                unrolled_cumprod(continues * gamma) / gamma
             )
             moments, offset, invscale = update_moments(
                 moments,

@@ -32,6 +32,7 @@ from ...config import Config, instantiate
 from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from ...distributions import Bernoulli, Independent, Normal
 from ...data.device_ring import estimate_row_bytes, make_sequential_prefetcher
+from ...ops.transforms import unrolled_cumprod
 from ...optim import clipped
 from ...parallel import Distributed
 from ...parallel.placement import make_param_mirror, player_device
@@ -244,8 +245,8 @@ def make_train_fn(
                     lmbda=lmbda,
                 )
                 discount = jax.lax.stop_gradient(
-                    jnp.cumprod(
-                        jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-2]], 0), 0
+                    unrolled_cumprod(
+                        jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-2]], 0)
                     )
                 )
                 aux = {

@@ -41,6 +41,7 @@ from ...distributions import (
     TwoHotEncodingDistribution,
 )
 from ...ops import lambda_values as lambda_values_op
+from ...ops.transforms import unrolled_cumprod
 from ...optim import clipped
 from ...parallel import Distributed
 from ...parallel.mesh import maybe_shard_opt_state
@@ -291,7 +292,7 @@ def make_train_fn(
         def expl_actor_loss_fn(actor_params, moments_expl):
             trajectories, imagined_actions = rollout(actor_params, k_img_expl)
             continues = continues_of(trajectories)
-            discount = jax.lax.stop_gradient(jnp.cumprod(continues * gamma, axis=0) / gamma)
+            discount = jax.lax.stop_gradient(unrolled_cumprod(continues * gamma) / gamma)
             advantage = 0.0
             new_moments = {}
             lv_per_critic = {}
@@ -378,7 +379,7 @@ def make_train_fn(
             ).mean
             continues = continues_of(trajectories)
             lv = lambda_values_op(rewards_img[1:], values[1:], continues[1:] * gamma, lmbda)
-            discount = jax.lax.stop_gradient(jnp.cumprod(continues * gamma, axis=0) / gamma)
+            discount = jax.lax.stop_gradient(unrolled_cumprod(continues * gamma) / gamma)
             m, offset, invscale = moments_step(moments_task, lv)
             normed_lv = (lv - offset) / invscale
             normed_baseline = (values[:-1] - offset) / invscale

@@ -10,6 +10,18 @@ import jax
 import jax.numpy as jnp
 
 
+def unrolled_cumprod(x: jax.Array) -> jax.Array:
+    """Cumulative product over a SHORT, static leading axis (the imagination
+    horizon) as an unrolled multiply chain. `jnp.cumprod` lowers to an
+    O(T*window) `reduce_window` on the XLA CPU backend (~2.6 ms per exec at
+    the DreamerV3 bench shapes — profiled r5); T fused elementwise multiplies
+    compile to nothing on every backend, and TPU loses nothing."""
+    outs = [x[0]]
+    for t in range(1, x.shape[0]):
+        outs.append(outs[-1] * x[t])
+    return jnp.stack(outs, axis=0)
+
+
 def symlog(x: jax.Array) -> jax.Array:
     return jnp.sign(x) * jnp.log1p(jnp.abs(x))
 

@@ -84,3 +84,16 @@ def test_lambda_values_matches_reference():
     ref = _lambda_python(rewards, values, continues, 0.95)
     assert out.shape == (T, B, 1)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_unrolled_cumprod_matches_jnp():
+    from sheeprl_tpu.ops.transforms import unrolled_cumprod
+
+    x = jax.random.uniform(jax.random.key(0), (16, 33, 1)) + 0.1
+    np.testing.assert_allclose(
+        np.asarray(unrolled_cumprod(x)), np.asarray(jnp.cumprod(x, axis=0)), rtol=1e-6
+    )
+    # gradient parity with the builtin
+    g1 = jax.grad(lambda v: jnp.sum(unrolled_cumprod(v) ** 2))(x)
+    g2 = jax.grad(lambda v: jnp.sum(jnp.cumprod(v, axis=0) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5)
