@@ -1,0 +1,78 @@
+"""Stable-Baselines3 comparator driver (counterpart of reference
+benchmarks/benchmark_sb3.py): times SB3's PPO / A2C / SAC over 65,536 steps
+with the REFERENCE COMPARATOR's own env shapes — one CartPole env for
+PPO/A2C, a 4-env DummyVecEnv for SAC — which is how the SB3 v2.2.1 numbers
+pinned in BASELINE.md (77.21 s PPO / 84.22 s A2C / 336.06 s SAC on 4 CPUs)
+were produced. NOTE: `bench.py ppo|a2c` step 4 envs in parallel, so compare
+against the BASELINE table, not leg-for-leg against bench.py.
+
+    python benchmarks/benchmark_sb3.py [ppo|a2c|sac]
+
+SB3 is NOT part of this image — the script exits with a labeled JSON record
+(`"error": "stable_baselines3 not installed"`) instead of a traceback, the
+same gating convention as the suite adapters.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+TOTAL_STEPS = 1024 * 64
+
+
+def record(which: str) -> dict:
+    try:
+        import gymnasium as gym
+        import stable_baselines3 as sb3
+    except ModuleNotFoundError as err:
+        return {
+            "metric": f"SB3 {which.upper()} {TOTAL_STEPS}-step wall-clock",
+            "value": 0.0,
+            "unit": "seconds",
+            "error": f"{err.name} not installed (comparator is optional; see BASELINE.md)",
+        }
+
+    try:
+        return _timed(which, gym, sb3)
+    except Exception as err:  # env deregistrations/extras (e.g. box2d) vary
+        return {
+            "metric": f"SB3 {which.upper()} {TOTAL_STEPS}-step wall-clock",
+            "value": 0.0,
+            "unit": "seconds",
+            "error": f"{type(err).__name__}: {err}",
+        }
+
+
+def _timed(which: str, gym, sb3) -> dict:
+    t0 = time.perf_counter()
+    if which == "ppo":
+        env = gym.make("CartPole-v1", render_mode="rgb_array")
+        model = sb3.PPO("MlpPolicy", env, verbose=0, device="cpu", n_steps=128)
+    elif which == "a2c":
+        env = gym.make("CartPole-v1", render_mode="rgb_array")
+        model = sb3.A2C("MlpPolicy", env, verbose=0, device="cpu", vf_coef=1.0)
+    elif which == "sac":
+        env = sb3.common.vec_env.DummyVecEnv(
+            [lambda: gym.make("LunarLanderContinuous-v2", render_mode="rgb_array") for _ in range(4)]
+        )
+        model = sb3.SAC("MlpPolicy", env, verbose=0, device="cpu")
+    else:
+        raise ValueError(f"unknown recipe '{which}' (ppo | a2c | sac)")
+    model.learn(total_timesteps=TOTAL_STEPS, log_interval=None)
+    elapsed = time.perf_counter() - t0
+
+    eval_env = env.envs[0] if hasattr(env, "envs") else env
+    mean_reward, std_reward = sb3.common.evaluation.evaluate_policy(model.policy, eval_env)
+    return {
+        "metric": f"SB3 {which.upper()} {TOTAL_STEPS}-step wall-clock",
+        "value": round(elapsed, 2),
+        "unit": "seconds",
+        "steps_per_second": round(TOTAL_STEPS / elapsed, 2),
+        "eval_reward_mean": round(float(mean_reward), 2),
+        "eval_reward_std": round(float(std_reward), 2),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(record(sys.argv[1] if len(sys.argv) > 1 else "ppo")))

@@ -44,7 +44,13 @@ Padding = Tuple[Tuple[int, int], Tuple[int, int]]
 def resolve_conv_impl(impl: str) -> bool:
     """True -> use the einsum lowering. "auto" picks it on the CPU backend
     (where the XLA conv gradients are pathological) and keeps native convs on
-    TPU/GPU (the MXU conv path is already optimal there)."""
+    TPU/GPU (the MXU conv path is already optimal there).
+
+    "auto" keys off ``jax.default_backend()`` at TRACE time, not the device
+    the program ultimately runs on: a process whose default backend is CPU
+    but that trains on an explicitly selected accelerator device would get
+    the einsum path (and vice versa). In that split setup, force the choice
+    with ``conv_impl: einsum`` / ``xla``."""
     if impl == "einsum":
         return True
     if impl == "xla":
@@ -222,7 +228,15 @@ def conv_transpose2d_k4s2p1(x: jax.Array, kernel: jax.Array, phases: bool = Fals
     and skips the depth-to-space interleave — whose *backward* transpose is
     the single most expensive op of the CPU DV3 gradient step. Training can
     evaluate the observation MSE directly in phase space against a
-    `phase_split_nhwc` of the (gradient-free) target."""
+    `phase_split_nhwc` of the (gradient-free) target.
+
+    FLOP note: the combined [3, 3, C_in, 4*C_out] kernel is ~55% structural
+    zeros (per _TR_TAPS: 1 tap carries all 4 phase blocks, 4 edge taps carry
+    2, 4 corner taps carry 1 — 16 nonzero of 36 blocks), so the shared 9-tap
+    GEMM core does ~2.25x the minimal FLOPs — and the custom VJP computes
+    kernel gradients for the zero blocks too. Deliberate: one regular GEMM
+    beats per-tap irregular kernels on CPU at these channel widths; mask the
+    zero taps if this path ever matters at much wider channels."""
     kh, kw, cout, cin = kernel.shape
     assert (kh, kw) == (4, 4), (kh, kw)
     w = jnp.transpose(kernel[::-1, ::-1], (0, 1, 3, 2))  # flip + [4,4,CI,CO]
