@@ -22,14 +22,20 @@ tests/test_pallas_gru.py): per step
     r, c, u = split(y, 3)
     h'  = sigmoid(u - 1) * tanh(sigmoid(r) * c) + (1 - sigmoid(u - 1)) * h
 
-Training support: `gru_sequence` is a `jax.custom_vjp` — the forward pass
-runs the Pallas kernel, the backward pass differentiates the pure-JAX
-reference scan (same FLOPs as the status-quo backward, so the kernel
-accelerates the forward recurrence without a hand-written BPTT kernel).
+Training support: `gru_sequence` is a `jax.custom_vjp` — BOTH passes are
+Pallas kernels. The backward (`_pallas_backward`) is a reverse BPTT sweep
+over the same sequential grid: the weight block and its gradient
+accumulator stay VMEM-resident across all T steps, the recurrent cotangent
+lives in scratch, and each step recomputes its pre-activations from the
+saved hidden states (one extra MXU matmul per step buys O(T·B·H) memory —
+no XLA activation stack). Gradient parity with the XLA reference-scan VJP
+is tested for every input, including the is_first routing into h_first.
 
 Guarded: falls back to the XLA scan when the weight block would not fit
-comfortably in VMEM (`fits_vmem`) or when not running on TPU. Select with
-``algo.world_model.pallas_gru=True`` (DreamerV3 decoupled path).
+comfortably in VMEM (`fits_vmem` — the budget already accounts for the
+backward holding weights + accumulator, i.e. two blocks) or when not
+running on TPU. Select with ``algo.world_model.pallas_gru=True``
+(DreamerV3 decoupled path).
 """
 from __future__ import annotations
 
@@ -40,29 +46,46 @@ import jax
 import jax.numpy as jnp
 
 _EPS = 1e-3
-# leave headroom in the ~16 MB/core VMEM for activations and double buffering
-_VMEM_WEIGHT_BUDGET_BYTES = 8 * 1024 * 1024
+# ~16 MB/core VMEM, minus headroom for the per-step blocks, scratch and
+# double buffering; the BACKWARD sweep keeps two weight-sized blocks
+# resident (weights + the dW accumulator), so the guard budgets 2x
+_VMEM_RESIDENT_BUDGET_BYTES = 14 * 1024 * 1024
 
 
 def fits_vmem(in_features: int, hidden_size: int, dtype_bytes: int = 4) -> bool:
-    """Whether the fused [F+H, 3H] weight block fits the kernel's VMEM
-    budget (true for the XS/S DreamerV3 presets; M/L/XL fall back)."""
-    return (in_features + hidden_size) * 3 * hidden_size * dtype_bytes <= _VMEM_WEIGHT_BUDGET_BYTES
+    """Whether BOTH weight-sized resident blocks of the backward sweep (the
+    fused [F+H, 3H] weights and their gradient accumulator) fit the VMEM
+    budget — the binding constraint since the backward became a Pallas
+    kernel. True for the XS/S DreamerV3 presets; M/L/XL fall back."""
+    block = (in_features + hidden_size) * 3 * hidden_size * dtype_bytes
+    return 2 * block <= _VMEM_RESIDENT_BUDGET_BYTES
+
+
+def _cell_parts(x, h_in, w, scale, bias, hidden_size: int):
+    """The LN-GRU step from the (already reset-blended) carry ``h_in``,
+    returning every intermediate the backward sweep needs to recompute —
+    ONE definition of the cell math shared by the forward kernel, the
+    reference scan and the backward recompute, so the semantics cannot
+    drift between passes. Returns (xh, istd, yn, r, y2, c, u, h_out)."""
+    xh = jnp.concatenate([x, h_in], axis=-1)
+    y_raw = jnp.dot(xh, w, preferred_element_type=jnp.float32)
+    mu = jnp.mean(y_raw, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(y_raw - mu), axis=-1, keepdims=True)
+    istd = jax.lax.rsqrt(var + _EPS)
+    yn = (y_raw - mu) * istd
+    y = yn * scale + bias
+    r = jax.nn.sigmoid(y[..., :hidden_size])
+    y2 = y[..., hidden_size : 2 * hidden_size]
+    c = jnp.tanh(r * y2)
+    u = jax.nn.sigmoid(y[..., 2 * hidden_size :] - 1.0)
+    return xh, istd, yn, r, y2, c, u, u * c + (1.0 - u) * h_in
 
 
 def _cell(x, h, first, h_first, w, scale, bias, hidden_size: int):
-    """One LN-GRU step (shared by the kernel body and the reference scan)."""
-    h = (1.0 - first) * h + first * h_first
-    y = jnp.dot(
-        jnp.concatenate([x, h], axis=-1), w, preferred_element_type=jnp.float32
-    )
-    mu = jnp.mean(y, axis=-1, keepdims=True)
-    var = jnp.mean(jnp.square(y - mu), axis=-1, keepdims=True)
-    y = (y - mu) * jax.lax.rsqrt(var + _EPS) * scale + bias
-    reset = jax.nn.sigmoid(y[..., :hidden_size])
-    cand = jnp.tanh(reset * y[..., hidden_size : 2 * hidden_size])
-    update = jax.nn.sigmoid(y[..., 2 * hidden_size :] - 1.0)
-    return update * cand + (1.0 - update) * h
+    """One LN-GRU step incl. the is_first reset blend (kernel body and
+    reference scan)."""
+    h_in = (1.0 - first) * h + first * h_first
+    return _cell_parts(x, h_in, w, scale, bias, hidden_size)[-1]
 
 
 def reference_sequence(feats, first, h_first, w, scale, bias):
@@ -127,6 +150,124 @@ def _pallas_forward(feats, first, h_first, w, scale, bias, *, interpret: bool = 
     )
 
 
+def _pallas_backward(feats, first, h_prev, h_first, w, scale, bias, g, *, interpret: bool = False):
+    """Reverse BPTT sweep as one ``pallas_call`` with ``grid=(T,)`` run
+    back-to-front (reversed index maps): the weight block AND its gradient
+    accumulator stay VMEM-resident for the whole sweep, the recurrent
+    cotangent lives in a VMEM scratch, and each step recomputes its
+    pre-activations from the saved hidden states (memory stays O(T·B·H) —
+    what the forward already produced — instead of the XLA VJP's saved
+    activation stack).
+
+    ``h_prev[t]`` is the carry ENTERING step t (zeros at t=0, else
+    ``hs[t-1]``). Returns (dfeats, dh_first [B,H], dW, dscale, dbias)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, B, F = feats.shape
+    H = h_first.shape[-1]
+
+    def kernel(x_ref, f_ref, hprev_ref, hfirst_ref, w_ref, scale_ref, bias_ref, g_ref,
+               dx_ref, dhfirst_ref, dw_ref, dscale_ref, dbias_ref, dh_scratch):
+        t = pl.program_id(0)  # 0 processes the LAST time step (reversed maps)
+
+        @pl.when(t == 0)
+        def _init():
+            dh_scratch[:] = jnp.zeros_like(dh_scratch)
+            dhfirst_ref[:] = jnp.zeros_like(dhfirst_ref)
+            dw_ref[:] = jnp.zeros_like(dw_ref)
+            dscale_ref[:] = jnp.zeros_like(dscale_ref)
+            dbias_ref[:] = jnp.zeros_like(dbias_ref)
+
+        x = x_ref[0]            # [B, F]
+        f = f_ref[0]            # [B, 1]
+        h_first_row = hfirst_ref[:]
+        w_blk = w_ref[:]
+        sc = scale_ref[0]
+        bi = bias_ref[0]
+
+        # ---- recompute the step's forward pre-activations (shared math) --
+        h_in = (1.0 - f) * hprev_ref[0] + f * h_first_row
+        xh, istd, yn, r, y2, c, u, _ = _cell_parts(x, h_in, w_blk, sc, bi, H)
+
+        # ---- cell backward ----------------------------------------------
+        dh = g_ref[0] + dh_scratch[:]        # output grad + recurrent flow
+        du = dh * (c - h_in)
+        dc = dh * u
+        dh_in = dh * (1.0 - u)
+        dy_u = du * u * (1.0 - u)
+        d_rc = dc * (1.0 - c * c)
+        dr = d_rc * y2
+        dy_c = d_rc * r
+        dy_r = dr * r * (1.0 - r)
+        dy = jnp.concatenate([dy_r, dy_c, dy_u], axis=-1)        # [B, 3H]
+
+        # affine + layernorm backward (per row over D = 3H)
+        dscale_ref[0] += jnp.sum(dy * yn, axis=0)
+        dbias_ref[0] += jnp.sum(dy, axis=0)
+        dyn = dy * sc
+        dy_raw = istd * (
+            dyn
+            - jnp.mean(dyn, axis=-1, keepdims=True)
+            - yn * jnp.mean(dyn * yn, axis=-1, keepdims=True)
+        )
+
+        # matmul backward: two MXU matmuls against the resident weight block
+        dxh = jnp.dot(dy_raw, w_blk.T, preferred_element_type=jnp.float32)
+        dw_ref[:] += jnp.dot(xh.T, dy_raw, preferred_element_type=jnp.float32)
+        dx_ref[0] = dxh[..., :F]
+        dh_in = dh_in + dxh[..., F:]
+
+        # reset mask routes the carry cotangent
+        dh_scratch[:] = (1.0 - f) * dh_in
+        dhfirst_ref[:] += f * dh_in
+
+    rev = lambda t: (T - 1 - t, 0, 0)
+    const2 = lambda t: (0, 0)
+    dx, dh_first_acc, dw, dscale, dbias = pl.pallas_call(
+        kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, F), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, 1), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), const2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((F + H, 3 * H), const2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 3 * H), const2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 3 * H), const2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H), rev, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, F), rev, memory_space=pltpu.VMEM),
+            # accumulators: constant index maps keep the blocks resident;
+            # the last grid step's contents are the outputs
+            pl.BlockSpec((B, H), const2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((F + H, 3 * H), const2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 3 * H), const2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 3 * H), const2, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, F), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+            jax.ShapeDtypeStruct((F + H, 3 * H), jnp.float32),
+            jax.ShapeDtypeStruct((1, 3 * H), jnp.float32),
+            jax.ShapeDtypeStruct((1, 3 * H), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)],
+        interpret=interpret,
+    )(
+        feats.astype(jnp.float32),
+        first.astype(jnp.float32),
+        h_prev.astype(jnp.float32),
+        jnp.broadcast_to(h_first, (B, H)).astype(jnp.float32),
+        w.astype(jnp.float32),
+        scale.reshape(1, -1).astype(jnp.float32),
+        bias.reshape(1, -1).astype(jnp.float32),
+        g.astype(jnp.float32),
+    )
+    return dx, dh_first_acc, dw, dscale[0], dbias[0]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
 def gru_sequence(feats, first, h_first, w, scale, bias, interpret: bool = False):
     """LN-GRU over a whole [T, B, F] sequence with `is_first` resets.
@@ -137,21 +278,35 @@ def gru_sequence(feats, first, h_first, w, scale, bias, interpret: bool = False)
         h_first: [H] or [B, H] state the carry resets to where first==1.
         w:       [F+H, 3H] fused gate weights; `scale`/`bias`: [3H] LN params.
 
-    Returns [T, B, H] hidden states. Forward = Pallas kernel (VMEM-resident
-    weights); backward = VJP of the XLA reference scan.
-    """
+    Returns [T, B, H] hidden states. Forward AND backward are Pallas kernels
+    (VMEM-resident weights; the backward is a reverse BPTT sweep that
+    recomputes pre-activations from the saved hidden states, so training
+    gets the residency win too — VERDICT r4 #2 option (a))."""
     return _pallas_forward(feats, first, h_first, w, scale, bias, interpret=interpret)
 
 
 def _fwd(feats, first, h_first, w, scale, bias, interpret):
     out = _pallas_forward(feats, first, h_first, w, scale, bias, interpret=interpret)
-    return out, (feats, first, h_first, w, scale, bias)
+    return out, (feats, first, h_first, w, scale, bias, out)
 
 
 def _bwd(interpret, residuals, g) -> Tuple:
-    feats, first, h_first, w, scale, bias = residuals
-    _, vjp = jax.vjp(reference_sequence, feats, first, h_first, w, scale, bias)
-    return vjp(g)
+    feats, first, h_first, w, scale, bias, hs = residuals
+    h_prev = jnp.concatenate([jnp.zeros_like(hs[:1]), hs[:-1]], axis=0)
+    dx, dh_first, dw, dscale, dbias = _pallas_backward(
+        feats, first, h_prev, h_first, w, scale, bias, g, interpret=interpret
+    )
+    dfirst = jnp.zeros_like(first)  # the mask is data, never differentiated
+    if h_first.ndim == 1:  # forward broadcast [H] -> [B, H]: reduce back
+        dh_first = dh_first.sum(axis=0)
+    return (
+        dx.astype(feats.dtype),
+        dfirst,
+        dh_first.astype(h_first.dtype),
+        dw.astype(w.dtype),
+        dscale.astype(scale.dtype),
+        dbias.astype(bias.dtype),
+    )
 
 
 gru_sequence.defvjp(_fwd, _bwd)

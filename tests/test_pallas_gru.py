@@ -78,3 +78,31 @@ def test_decoupled_train_paths_agree():
     pal = burst_metrics(base + ["algo.world_model.pallas_gru=interpret"])
     for k in ("Loss/world_model_loss", "State/kl", "Loss/reward_loss"):
         assert ref[k] == pytest.approx(pal[k], rel=1e-4), (k, ref[k], pal[k])
+
+
+def test_hfirst_gradient_parity():
+    """Reset masks route carry cotangents into h_first; the BPTT kernel must
+    accumulate them exactly like the reference VJP (incl. the [H] -> [B, H]
+    broadcast reduction)."""
+    args = _inputs(3)
+
+    def loss_k(h_first):
+        return jnp.sum(gru_sequence(args[0], args[1], h_first, args[3], args[4], args[5], True) ** 2)
+
+    def loss_r(h_first):
+        return jnp.sum(reference_sequence(args[0], args[1], h_first, args[3], args[4], args[5]) ** 2)
+
+    gk = jax.grad(loss_k)(args[2])
+    gr = jax.grad(loss_r)(args[2])
+    assert gk.shape == (H,)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gr), rtol=1e-4, atol=1e-5)
+
+
+def test_batched_hfirst_gradient_parity():
+    feats, first, _, w, scale, bias = _inputs(4)
+    h_first = jax.random.normal(jax.random.key(9), (B, H)) * 0.3
+
+    gk = jax.grad(lambda hf: jnp.sum(gru_sequence(feats, first, hf, w, scale, bias, True) ** 2))(h_first)
+    gr = jax.grad(lambda hf: jnp.sum(reference_sequence(feats, first, hf, w, scale, bias) ** 2))(h_first)
+    assert gk.shape == (B, H)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gr), rtol=1e-4, atol=1e-5)
