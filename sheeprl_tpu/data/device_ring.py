@@ -292,7 +292,45 @@ class _EnvSlice:
         return self._parent.buffer_size
 
 
-class ShardedDeviceRingPrefetcher(_StagedGather):
+class _ShardedRing(_StagedGather):
+    """Shared mechanics of the dp-sharded ring variants: per-device shard
+    prefetchers built by the subclass, batches assembled pre-sharded with
+    :func:`jax.make_array_from_single_device_arrays` along the batch axis
+    the subclass names (2 for sequential [G, T, B], 1 for uniform [G, B])."""
+
+    _batch_axis: int  # set by subclasses
+    _shards: List[Any]
+    _batch_sharding: Any
+
+    @property
+    def ring(self) -> Optional[List[Dict[str, jax.Array]]]:
+        rings = [s.ring for s in self._shards]
+        return None if any(r is None for r in rings) else rings
+
+    def sync(self) -> None:
+        for s in self._shards:
+            s.sync()
+
+    def _gather(self, g: int) -> Any:
+        ax = self._batch_axis
+        parts = [s._gather(g) for s in self._shards]
+        out: Dict[str, jax.Array] = {}
+        for k in parts[0]:
+            shards = [p[k] for p in parts]
+            lead = shards[0].shape
+            shape = lead[:ax] + (sum(s.shape[ax] for s in shards),) + lead[ax + 1 :]
+            out[k] = jax.make_array_from_single_device_arrays(
+                shape, self._batch_sharding, shards
+            )
+        return out
+
+    def resync(self) -> None:
+        for s in self._shards:
+            s.resync()
+        self._staged = None
+
+
+class ShardedDeviceRingPrefetcher(_ShardedRing):
     """dp-sharded HBM replay ring for multi-device meshes (VERDICT r4 #3).
 
     Device ``d`` of the ``dp`` axis mirrors env block ``d`` and gathers its
@@ -339,36 +377,11 @@ class ShardedDeviceRingPrefetcher(_StagedGather):
             for d in range(D)
         ]
         self._batch_sharding = dist.sharding(None, None, "dp")  # [G, T, B, ...]
+        self._batch_axis = 2
         self._staged: Optional[tuple] = None
-
-    @property
-    def ring(self) -> Optional[List[Dict[str, jax.Array]]]:
-        rings = [s.ring for s in self._shards]
-        return None if any(r is None for r in rings) else rings
 
     def mark_dirty(self, env_idx: int, row: int) -> None:
         self._shards[env_idx // self._epd].mark_dirty(env_idx % self._epd, row)
-
-    def sync(self) -> None:
-        for s in self._shards:
-            s.sync()
-
-    def _gather(self, g: int) -> Any:
-        parts = [s._gather(g) for s in self._shards]  # each [G, L, B/D, ...]
-        out: Dict[str, jax.Array] = {}
-        for k in parts[0]:
-            shards = [p[k] for p in parts]
-            lead = shards[0].shape
-            shape = lead[:2] + (sum(s.shape[2] for s in shards),) + lead[3:]
-            out[k] = jax.make_array_from_single_device_arrays(
-                shape, self._batch_sharding, shards
-            )
-        return out
-
-    def resync(self) -> None:
-        for s in self._shards:
-            s.resync()
-        self._staged = None
 
 
 @functools.partial(jax.jit, donate_argnums=0)
@@ -490,6 +503,97 @@ class DeviceUniformRingPrefetcher(_StagedGather):
         self._staged = None
 
 
+class _UniformEnvSlice:
+    """View of a plain :class:`ReplayBuffer` restricted to a contiguous env
+    block — the uniform-ring counterpart of :class:`_EnvSlice`. Row-validity
+    state (`_pos`/`_added`/`full`) is shared with the parent (the buffer
+    adds in lockstep across envs); env draws are re-sampled locally from the
+    parent's checkpointed rng so each device's columns come from its own
+    block."""
+
+    def __init__(self, rb: Any, lo: int, hi: int):
+        self._parent = rb
+        self._lo, self._hi = int(lo), int(hi)
+        self._rng = rb._rng
+        self._obs_keys = rb._obs_keys
+
+    @property
+    def buffer_size(self) -> int:
+        return self._parent.buffer_size
+
+    @property
+    def n_envs(self) -> int:
+        return self._hi - self._lo
+
+    @property
+    def empty(self) -> bool:
+        return self._parent.empty
+
+    @property
+    def full(self) -> bool:
+        return self._parent.full
+
+    @property
+    def _pos(self) -> int:
+        return self._parent._pos
+
+    @property
+    def _added(self) -> int:
+        return self._parent._added
+
+    def keys(self):
+        return self._parent.keys()
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        return np.asarray(self._parent[key])[:, self._lo : self._hi]
+
+    def sample_indices(self, total: int, sample_next_obs: bool = False):
+        # parent row validity + a local env draw (uniform over this block ==
+        # the global uniform conditioned on the block, since adds are lockstep)
+        idxs, _ = self._parent.sample_indices(total, sample_next_obs)
+        return idxs, self._rng.integers(0, self.n_envs, size=total)
+
+
+class ShardedDeviceUniformRingPrefetcher(_ShardedRing):
+    """dp-sharded uniform ([G, B, ...]) HBM ring — the SAC-family twin of
+    :class:`ShardedDeviceRingPrefetcher`: device *d* mirrors env block *d*
+    via :class:`_UniformEnvSlice` + a per-device
+    :class:`DeviceUniformRingPrefetcher`; the global batch is assembled
+    pre-sharded as ``P(None, "dp")`` with no collectives."""
+
+    def __init__(
+        self,
+        rb: Any,
+        batch_size: int,
+        cnn_keys: Sequence[str] = (),
+        sample_next_obs: bool = False,
+        dist: Any = None,
+        bucket: int = 8,
+    ):
+        devs = list(dist.mesh.devices.flatten())
+        D = len(devs)
+        if rb.n_envs % D or batch_size % D:
+            raise ValueError(
+                f"sharded uniform ring needs n_envs ({rb.n_envs}) and batch_size "
+                f"({batch_size}) divisible by the mesh size ({D})"
+            )
+        epd = rb.n_envs // D
+        self._shards = [
+            DeviceUniformRingPrefetcher(
+                _UniformEnvSlice(rb, d * epd, (d + 1) * epd),
+                batch_size // D,
+                cnn_keys=cnn_keys,
+                sample_next_obs=sample_next_obs,
+                device=devs[d],
+                bucket=bucket,
+            )
+            for d in range(D)
+        ]
+        self._batch_sharding = dist.sharding(None, "dp")  # [G, B, ...]
+        self._batch_axis = 1
+        self._staged: Optional[tuple] = None
+
+
 def _ring_mode(cfg: Any) -> str:
     """Parse buffer.device_cache: YAML booleans arrive as real bools, so
     `device_cache: false` must force the ring OFF, not fall through an
@@ -541,6 +645,37 @@ def estimate_row_bytes(obs_space: Any, act_dim: int) -> int:
     return total + 4 * int(act_dim) + 4 * 4
 
 
+def _sharded_or_fallback(cfg: Any, dist: Any, rb: Any, batch_size: int, make_sharded):
+    """The multi-device ring-vs-fallback policy shared by both replay paths:
+    build the dp-sharded ring when the mesh is process-local and n_envs /
+    the global batch divide it; otherwise raise under forced
+    ``device_cache=true`` or fall back to host staging with a stderr note.
+    Returns the sharded prefetcher or None (= caller uses the host path)."""
+    local = set(jax.local_devices())
+    if any(d not in local for d in dist.mesh.devices.flat):
+        # multi-host mesh: this process cannot device_put to other
+        # processes' chips — replay stays host-staged (each process feeds
+        # its own shard of the dp batch)
+        msg = (
+            "sharded device ring requires all mesh devices to be "
+            "process-local (multi-host meshes stay host-staged)"
+        )
+    elif rb.n_envs % dist.world_size == 0 and batch_size % dist.world_size == 0:
+        return make_sharded()
+    else:
+        msg = (
+            f"sharded device ring needs env.num_envs ({rb.n_envs}) and the "
+            f"global batch size ({batch_size}) divisible by the mesh size "
+            f"({dist.world_size})"
+        )
+    if _ring_mode(cfg) == "true":  # explicitly forced: fail loudly
+        raise ValueError(msg)
+    import sys
+
+    print(f"[device_ring] {msg}; falling back to host-staged batches", file=sys.stderr)
+    return None
+
+
 def make_sequential_prefetcher(
     cfg: Any,
     dist: Any,
@@ -570,30 +705,14 @@ def make_sequential_prefetcher(
             return DeviceRingPrefetcher(
                 rb, batch_size, sequence_length, cnn_keys=cnn_keys, device=dist.local_device
             )
-        local = set(jax.local_devices())
-        if any(d not in local for d in dist.mesh.devices.flat):
-            # multi-host mesh: this process cannot device_put to other
-            # processes' chips — replay stays host-staged (each process
-            # feeds its own shard of the dp batch)
-            msg = (
-                "sharded device ring requires all mesh devices to be "
-                "process-local (multi-host meshes stay host-staged)"
-            )
-        elif rb.n_envs % dist.world_size == 0 and batch_size % dist.world_size == 0:
-            return ShardedDeviceRingPrefetcher(
+        sharded = _sharded_or_fallback(
+            cfg, dist, rb, batch_size,
+            lambda: ShardedDeviceRingPrefetcher(
                 rb, batch_size, sequence_length, cnn_keys=cnn_keys, dist=dist
-            )
-        else:
-            msg = (
-                f"sharded device ring needs env.num_envs ({rb.n_envs}) and the "
-                f"global batch size ({batch_size}) divisible by the mesh size "
-                f"({dist.world_size})"
-            )
-        if _ring_mode(cfg) == "true":  # explicitly forced: fail loudly
-            raise ValueError(msg)
-        import sys
-
-        print(f"[device_ring] {msg}; falling back to host-staged batches", file=sys.stderr)
+            ),
+        )
+        if sharded is not None:
+            return sharded
     if host_sample_fn is None:
         def host_sample_fn(g):  # noqa: F811 — default sequential host sample
             s = rb.sample(batch_size, sequence_length=sequence_length, n_samples=g)
@@ -616,15 +735,29 @@ def make_uniform_prefetcher(
 ):
     """Prefetcher for the uniform-replay (SAC-family) train loops: the HBM
     ring under the same ``buffer.device_cache`` policy as the sequential
-    path, else host sampling staged one burst ahead ([G, B, ...] batches)."""
-    if _use_ring(cfg, dist, row_bytes_hint, rb.buffer_size * rb.n_envs):
-        return DeviceUniformRingPrefetcher(
-            rb,
-            batch_size,
-            cnn_keys=cnn_keys,
-            sample_next_obs=sample_next_obs,
-            device=dist.local_device,
+    path (incl. the dp-sharded variant on multi-device meshes), else host
+    sampling staged one burst ahead ([G, B, ...] batches)."""
+    if _use_ring(cfg, dist, row_bytes_hint, rb.buffer_size * rb.n_envs, multi_ok=True):
+        if dist.world_size == 1:
+            return DeviceUniformRingPrefetcher(
+                rb,
+                batch_size,
+                cnn_keys=cnn_keys,
+                sample_next_obs=sample_next_obs,
+                device=dist.local_device,
+            )
+        sharded = _sharded_or_fallback(
+            cfg, dist, rb, batch_size,
+            lambda: ShardedDeviceUniformRingPrefetcher(
+                rb,
+                batch_size,
+                cnn_keys=cnn_keys,
+                sample_next_obs=sample_next_obs,
+                dist=dist,
+            ),
         )
+        if sharded is not None:
+            return sharded
     if host_sample_fn is None:
         def host_sample_fn(g):  # noqa: F811 — default uniform host sample
             s = rb.sample(batch_size * g, sample_next_obs=sample_next_obs, n_samples=1)

@@ -37,13 +37,23 @@ def test_ppo(standard_args, env_id):
     )
 
 
-@pytest.mark.parametrize("device_cache", ["auto", "true"])
-def test_sac(standard_args, device_cache):
+@pytest.mark.parametrize(
+    "device_cache, n_devices",
+    [
+        ("auto", 1),
+        ("true", 1),
+        # devices=2 forces the dp-SHARDED uniform ring (per-device env
+        # blocks, batches assembled pre-sharded P(None, "dp"))
+        pytest.param("true", 2, id="true-sharded"),
+    ],
+)
+def test_sac(standard_args, device_cache, n_devices):
     _run(
         [
             "exp=sac",
             "env=dummy",
             "env.id=continuous_dummy",
+            f"fabric.devices={n_devices}",
             "algo.per_rank_batch_size=4",
             "algo.hidden_size=8",
             "algo.learning_starts=0",

@@ -221,9 +221,9 @@ def test_uniform_next_obs_parity():
     assert batch["next_rgb"].dtype == np.uint8
 
 
-def test_forced_ring_rejects_multidevice_mesh_on_uniform_path():
-    """The uniform (SAC-family) ring is still single-device; only the
-    sequential path shards over dp (multi_ok)."""
+def test_forced_ring_multidevice_policy():
+    """Both replay paths shard over dp now; _use_ring still raises for any
+    caller that does NOT declare multi-device support (multi_ok=False)."""
     from sheeprl_tpu.data.device_ring import _use_ring
 
     class _Cfg:
@@ -333,3 +333,45 @@ def test_sharded_requires_divisible_sizes():
     )
     with pytest.raises(ValueError, match="divisible"):
         ShardedDeviceRingPrefetcher(rb, 4, 2, dist=dist)
+
+
+def test_sharded_uniform_gather_matches_host():
+    """SAC-family twin: per-device env blocks, [G, B] batches pre-sharded
+    P(None, 'dp'), content bit-identical to the host arrays."""
+    from sheeprl_tpu.data import ReplayBuffer
+    from sheeprl_tpu.data.device_ring import ShardedDeviceUniformRingPrefetcher
+    from sheeprl_tpu.parallel import Distributed
+
+    dist = Distributed(devices=2)
+    rb = ReplayBuffer(32, n_envs=4, obs_keys=KEYS, seed=5)
+    for t in range(20):
+        rb.add(_row_per_env(t, 4))
+    ring = ShardedDeviceUniformRingPrefetcher(
+        rb, 8, cnn_keys=("rgb",), sample_next_obs=True, dist=dist
+    )
+    batch = ring.take(2)
+    assert batch["state"].shape == (2, 8, 3)
+    assert batch["state"].sharding.spec == jax.sharding.PartitionSpec(None, "dp")
+    assert "next_state" in batch and batch["rgb"].dtype == np.uint8
+    host = np.asarray(batch["state"])  # state = 1000*t + env
+    for g in range(2):
+        for b in range(8):
+            t = int(host[g, b, 0] // 1000)
+            env = int(host[g, b, 0] % 1000)
+            # device d owns envs [2d, 2d+2): column b belongs to device b//4
+            assert env // 2 == b // 4, (env, b)
+            np.testing.assert_array_equal(host[g, b], rb["state"][t, env])
+            np.testing.assert_array_equal(
+                np.asarray(batch["next_state"])[g, b], rb["state"][(t + 1) % 32, env]
+            )
+
+
+def test_sharded_uniform_requires_divisible_sizes():
+    from sheeprl_tpu.data import ReplayBuffer
+    from sheeprl_tpu.data.device_ring import ShardedDeviceUniformRingPrefetcher
+    from sheeprl_tpu.parallel import Distributed
+
+    dist = Distributed(devices=2)
+    rb = ReplayBuffer(16, n_envs=3, obs_keys=KEYS)
+    with pytest.raises(ValueError, match="divisible"):
+        ShardedDeviceUniformRingPrefetcher(rb, 4, dist=dist)
