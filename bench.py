@@ -318,6 +318,10 @@ def main() -> None:
         else:
             print(f"[bench] preflight ok: {pre}", file=sys.stderr)
         step_budget = float(os.environ.get("BENCH_STEP_BUDGET_S", 420))
+        # pass an ABSOLUTE deadline so the child's timing loop can shrink to
+        # what truly remains (its own clock starts after imports/build — a
+        # relative budget would overestimate and still get killed)
+        os.environ["BENCH_STEP_DEADLINE"] = str(time.time() + step_budget)
         step_rec = _run_subprocess_record(["dv3_step"], step_budget)
         if step_rec is not None:
             print(json.dumps(step_rec), flush=True)

@@ -180,19 +180,47 @@ def record() -> dict:
 
     _phase(f"cost_analysis done (flops={flops_per_step}); compiling + warmup")
     tkey = jax.random.key(1)
-    # compile + settle
+    # compile + settle; the per-step warmup time picks the cap granularity
+    _t_warm = time.perf_counter()
     for _ in range(3):
         tkey, k = jax.random.split(tkey)
         params, opt_states, moments, metrics = train(
             params, opt_states, moments, data, jax.random.split(k, 1)
         )
     jax.block_until_ready(metrics)
-    _phase("warmup done; timing")
+    _phase(f"warmup done in {time.perf_counter() - _t_warm:.1f}s (incl. any compile); probing")
+    # one timed step AFTER warmup (compile already paid) classifies the
+    # host speed for the sync granularity below — averaging the compile in
+    # would misread a fast chip with a cold cache as a slow host
+    _t_probe = time.perf_counter()
+    tkey, k = jax.random.split(tkey)
+    params, opt_states, moments, metrics = train(
+        params, opt_states, moments, data, jax.random.split(k, 1)
+    )
+    jax.block_until_ready(metrics)
+    warm_step_s = time.perf_counter() - _t_probe
+    _phase(f"probe step {warm_step_s:.2f}s; timing")
 
     # time-capped: on a slow link/machine stop early and report SPS over the
-    # reps that ran, instead of being killed by the subprocess budget
+    # reps that ran, instead of being killed by the subprocess budget. The
+    # cap also shrinks to whatever remains of the SUBPROCESS budget
+    # (BENCH_STEP_BUDGET_S) after setup/compile — a cold compile must
+    # degrade to a few-rep measurement, not a budget kill with no record.
     max_reps = 20
     cap_s = float(os.environ.get("BENCH_STEP_WALL_S", 240))
+    deadline = os.environ.get("BENCH_STEP_DEADLINE")
+    if deadline:
+        # absolute wall-clock deadline set by the parent at SPAWN time, so
+        # pre-setup costs (imports, config, build) are accounted exactly;
+        # 45 s tail covers one in-flight step past the cap check + the
+        # final sync and record print
+        cap_s = max(10.0, min(cap_s, float(deadline) - time.time() - 45.0))
+    # dispatch is async, so the wall check must SYNC first or it never
+    # fires. Granularity is adaptive: a slow host (seconds per step) syncs
+    # every rep — pipelining is irrelevant there and a coarser check would
+    # blow straight past the budget; a fast chip keeps the 5-rep pipeline
+    # (per-rep syncs over a remote link would dominate the measurement).
+    sync_every = 1 if warm_step_s > 1.0 else 5
     reps = 0
     t0 = time.perf_counter()
     while reps < max_reps:
@@ -201,7 +229,7 @@ def record() -> dict:
             params, opt_states, moments, data, jax.random.split(k, 1)
         )
         reps += 1
-        if reps % 5 == 0 or reps == max_reps:
+        if reps % sync_every == 0 or reps == max_reps:
             jax.block_until_ready(metrics)
             if time.perf_counter() - t0 > cap_s:
                 break
