@@ -1,0 +1,59 @@
+"""keep_best_bench guards the round-close artifact: only healthy e2e
+ACCELERATOR headlines may become artifacts/BENCH_TPU_BEST.json."""
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+spec = importlib.util.spec_from_file_location(
+    "keep_best_bench", os.path.join(REPO, "scripts", "keep_best_bench.py")
+)
+kb = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(kb)
+
+
+def _run(tmp_path, monkeypatch, rec, best=None):
+    monkeypatch.setattr(kb, "BEST", str(tmp_path / "BEST.json"))
+    if best is not None:
+        (tmp_path / "BEST.json").write_text(json.dumps(best))
+    src = tmp_path / "rec.json"
+    src.write_text(json.dumps(rec))
+    monkeypatch.setattr(sys, "argv", ["keep_best_bench.py", str(src)])
+    kb.main()
+    out = tmp_path / "BEST.json"
+    return json.loads(out.read_text()) if out.exists() else None
+
+
+E2E = {"metric": "DreamerV3 e2e", "unit": "env steps/sec", "vs_baseline": 2.0, "platform": "tpu"}
+
+
+def test_promotes_healthy_accelerator_e2e(tmp_path, monkeypatch):
+    best = _run(tmp_path, monkeypatch, E2E)
+    assert best["vs_baseline"] == 2.0 and best["source_file"] == "rec.json"
+
+
+def test_rejects_cpu_and_missing_platform(tmp_path, monkeypatch):
+    assert _run(tmp_path, monkeypatch, {**E2E, "platform": "cpu-fallback"}) is None
+    rec = dict(E2E)
+    del rec["platform"]
+    assert _run(tmp_path, monkeypatch, rec) is None
+
+
+def test_rejects_promoted_compute_only_and_error_records(tmp_path, monkeypatch):
+    # each rejection condition on its own: a wrong unit (promoted step
+    # record — different baseline, not comparable), an e2e_error marker,
+    # and an error marker must each independently block promotion
+    assert _run(tmp_path, monkeypatch, {**E2E, "unit": "steps/s"}) is None
+    assert _run(tmp_path, monkeypatch, {**E2E, "e2e_error": "budget exceeded"}) is None
+    assert _run(tmp_path, monkeypatch, {**E2E, "error": "link died mid-run"}) is None
+
+
+def test_keeps_existing_better_record(tmp_path, monkeypatch):
+    best = _run(tmp_path, monkeypatch, {**E2E, "vs_baseline": 1.5}, best={**E2E, "vs_baseline": 3.0})
+    assert best["vs_baseline"] == 3.0
+
+
+def test_replaces_worse_record(tmp_path, monkeypatch):
+    best = _run(tmp_path, monkeypatch, {**E2E, "vs_baseline": 3.5}, best={**E2E, "vs_baseline": 3.0})
+    assert best["vs_baseline"] == 3.5
