@@ -53,6 +53,20 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from sheeprl_tpu.telemetry.sinks import write_event  # noqa: E402
+
+
+def _emit(rec: dict) -> None:
+    """One bench record → one schema-validated JSONL line on stdout (the
+    driver still parses the LAST stdout line; `event: bench` rides along)."""
+    write_event({"event": "bench", **rec}, sys.stdout)
+
+
+def _progress(msg: str, **fields) -> None:
+    """Progress/diagnostic lines → JSONL events on stderr (same schema as
+    the in-run telemetry stream)."""
+    write_event({"event": "bench_progress", "msg": msg, **fields}, sys.stderr)
+
 # reference README.md:97-148 (v0.5.5, 4 CPU): 65_536-step wall-clock recipes
 RECIPE_BASELINE_SECONDS = {"ppo": 81.27, "a2c": 84.76, "sac": 320.21}
 RECIPE_EXPS = {"ppo": "ppo_benchmarks", "a2c": "a2c_benchmarks", "sac": "sac_benchmarks"}
@@ -174,10 +188,10 @@ def _run_subprocess_record(argv: list, budget_s: float) -> dict | None:
             cmd, stdout=subprocess.PIPE, stderr=sys.stderr, timeout=budget_s, text=True
         )
     except subprocess.TimeoutExpired:
-        print(f"[bench] {' '.join(argv)} exceeded {budget_s}s budget", file=sys.stderr)
+        _progress(f"{' '.join(argv)} exceeded {budget_s}s budget")
         return None
     if proc.returncode != 0:
-        print(f"[bench] {' '.join(argv)} exited rc={proc.returncode}", file=sys.stderr)
+        _progress(f"{' '.join(argv)} exited rc={proc.returncode}")
         return None
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
     if not lines:
@@ -185,7 +199,7 @@ def _run_subprocess_record(argv: list, budget_s: float) -> dict | None:
     try:
         return json.loads(lines[-1])
     except json.JSONDecodeError:
-        print(f"[bench] {' '.join(argv)} last line not JSON: {lines[-1]!r}", file=sys.stderr)
+        _progress(f"{' '.join(argv)} last line not JSON: {lines[-1]!r}")
         return None
 
 
@@ -203,6 +217,7 @@ def bench_preflight() -> dict:
         "ok": True,
         "device": str(dev),
         "platform": dev.platform,
+        "device_kind": str(getattr(dev, "device_kind", "")),
         "seconds": round(time.perf_counter() - t0, 2),
     }
 
@@ -228,27 +243,26 @@ def main() -> None:
             budget = float(os.environ.get("BENCH_PREFLIGHT_BUDGET_S", PREFLIGHT_BUDGET_DEFAULT_S))
             pre = _run_subprocess_record(["preflight"], budget)
             if pre is None or not pre.get("ok"):
-                print(
-                    f"[bench] {arg}: preflight failed within {budget}s; "
-                    "running on the host CPU backend (BENCH_FORCE_CPU=1)",
-                    file=sys.stderr,
+                _progress(
+                    f"{arg}: preflight failed within {budget}s; "
+                    "running on the host CPU backend (BENCH_FORCE_CPU=1)"
                 )
                 os.environ["BENCH_FORCE_CPU"] = "1"
         _maybe_force_cpu()
     if arg in RECIPE_EXPS:
-        print(json.dumps(bench_recipe(arg)))
+        _emit(bench_recipe(arg))
     elif arg in DREAMER_EXPS:
-        print(json.dumps(bench_dreamer_e2e(arg)))
+        _emit(bench_dreamer_e2e(arg))
     elif arg == "preflight":
         with contextlib.redirect_stdout(sys.stderr):
             rec = bench_preflight()
-        print(json.dumps(rec))
+        print(json.dumps(rec))  # preflight is a probe record, not a bench metric
     elif arg == "dv3_step":
         import bench_dv3
 
         with contextlib.redirect_stdout(sys.stderr):
             rec = bench_dv3.record()
-        print(json.dumps(rec))
+        _emit(rec)
     else:
         # share ONE persistent XLA compilation cache across the subprocess
         # legs, past bench runs AND regular `sheeprl_tpu run` invocations
@@ -290,10 +304,9 @@ def main() -> None:
                     break
                 pause = float(os.environ.get("BENCH_PREFLIGHT_RETRY_PAUSE_S", 15))
                 if attempt < retries and deadline - time.monotonic() > pause:
-                    print(
-                        f"[bench] preflight attempt {attempt}/{retries} failed; "
-                        f"retrying in {pause:.0f}s",
-                        file=sys.stderr,
+                    _progress(
+                        f"preflight attempt {attempt}/{retries} failed; "
+                        f"retrying in {pause:.0f}s"
                     )
                     time.sleep(pause)
         preflight_failed = not forced_cpu and (pre is None or not pre.get("ok"))
@@ -307,16 +320,15 @@ def main() -> None:
             # carries mfu/model_flops_per_step regardless of platform
             # (VERDICT r4 item 6).
             if preflight_failed:
-                print(
-                    f"[bench] preflight failed within {preflight_budget}s (tunnel down?); "
-                    "falling back to CPU measurement",
-                    file=sys.stderr,
+                _progress(
+                    f"preflight failed within {preflight_budget}s (tunnel down?); "
+                    "falling back to CPU measurement"
                 )
             else:
-                print("[bench] CPU run forced via BENCH_FORCE_CPU", file=sys.stderr)
+                _progress("CPU run forced via BENCH_FORCE_CPU")
             os.environ["BENCH_FORCE_CPU"] = "1"
         else:
-            print(f"[bench] preflight ok: {pre}", file=sys.stderr)
+            _progress("preflight ok", platform=pre.get("platform"), device_kind=pre.get("device_kind"), seconds=pre.get("seconds"))
         step_budget = float(os.environ.get("BENCH_STEP_BUDGET_S", 420))
         # pass an ABSOLUTE deadline so the child's timing loop can shrink to
         # what truly remains (its own clock starts after imports/build — a
@@ -324,7 +336,7 @@ def main() -> None:
         os.environ["BENCH_STEP_DEADLINE"] = str(time.time() + step_budget)
         step_rec = _run_subprocess_record(["dv3_step"], step_budget)
         if step_rec is not None:
-            print(json.dumps(step_rec), flush=True)
+            _emit(step_rec)
         e2e_budget = float(os.environ.get("BENCH_E2E_BUDGET_S", 1100))
         e2e_rec = _run_subprocess_record(["dv3"], e2e_budget)
         if e2e_rec is not None and cpu_fallback:
@@ -339,6 +351,7 @@ def main() -> None:
         if e2e_rec is not None:
             if not cpu_fallback and pre is not None:
                 e2e_rec["platform"] = pre.get("platform")
+                e2e_rec["device_kind"] = pre.get("device_kind", "")
                 e2e_rec["device"] = pre.get("device")
             if step_rec is not None:
                 # surface the utilization figures on the headline record
@@ -346,7 +359,7 @@ def main() -> None:
                     if key in step_rec:
                         e2e_rec[key] = step_rec[key]
                 e2e_rec["extra_metrics"] = [step_rec]
-            print(json.dumps(e2e_rec))
+            _emit(e2e_rec)
         elif step_rec is not None:
             step_rec["e2e_error"] = (
                 "end-to-end leg failed or exceeded its budget; compute-only record promoted"
@@ -361,23 +374,21 @@ def main() -> None:
                     else "cpu forced via BENCH_FORCE_CPU (preflight not the cause); "
                     "this is a host-CPU measurement"
                 )
-            print(json.dumps(step_rec))
+            _emit(step_rec)
         else:
-            print(
-                json.dumps(
-                    {
-                        "metric": "DreamerV3 bench",
-                        "value": 0.0,
-                        "unit": "env steps/sec",
-                        "vs_baseline": 0.0,
-                        "error": (
-                            "accelerator preflight failed (device client creation hung — "
-                            "tunnel down?) and the CPU fallback leg also failed (see stderr)"
-                            if cpu_fallback
-                            else "both bench legs failed (see stderr)"
-                        ),
-                    }
-                )
+            _emit(
+                {
+                    "metric": "DreamerV3 bench",
+                    "value": 0.0,
+                    "unit": "env steps/sec",
+                    "vs_baseline": 0.0,
+                    "error": (
+                        "accelerator preflight failed (device client creation hung — "
+                        "tunnel down?) and the CPU fallback leg also failed (see stderr)"
+                        if cpu_fallback
+                        else "both bench legs failed (see stderr)"
+                    ),
+                }
             )
 
 

@@ -13,7 +13,6 @@ RTX 3080 ⇒ 1.98 steps/s, BASELINE.md MsPacman row).
 """
 from __future__ import annotations
 
-import json
 import os
 import sys
 import time
@@ -26,51 +25,9 @@ BATCH = 16
 SEQ = 64
 N_ACTIONS = 9  # MsPacman
 
-# peak dense-matmul FLOP/s per chip by device kind (bf16 for TPUs — the MXU's
-# native precision and the standard MFU convention). Substring-matched.
-PEAK_FLOPS = {
-    "v6": 918e12,  # Trillium
-    "v5p": 459e12,
-    "v5e": 197e12,
-    "v5 lite": 197e12,
-    "v5litepod": 197e12,
-    "v4": 275e12,
-    "v3": 123e12,
-    "v2": 45e12,
-}
-
-
-def _peak_flops(device) -> float | None:
-    kind = (getattr(device, "device_kind", "") or "").lower()
-    for sub, peak in PEAK_FLOPS.items():
-        if sub in kind:
-            return peak
-    return None
-
-
-def _measured_peak_flops() -> float:
-    """Achievable dense-matmul FLOP/s on the host CPU backend, measured with
-    a jitted 1024³ f32 matmul (best of 5). The MFU denominator on fallback
-    runs, so utilization is recorded on EVERY bench path (labeled as
-    measured, not vendor peak). CPU-only: a 2.1 GFLOP matmul is milliseconds
-    there, far above dispatch noise — on a fast unknown accelerator it would
-    be latency-dominated and overstate MFU, so non-CPU unknowns omit mfu
-    instead."""
-    import jax
-    import jax.numpy as jnp
-
-    n = 1024
-    x = jnp.ones((n, n), jnp.float32)
-    f = jax.jit(lambda a: a @ a)
-    jax.block_until_ready(f(x))
-    best = min(_time_one(lambda: jax.block_until_ready(f(x))) for _ in range(5))
-    return 2 * n**3 / best
-
-
-def _time_one(fn) -> float:
-    t0 = time.perf_counter()
-    fn()
-    return time.perf_counter() - t0
+# The peak-FLOPs table and MFU math live in the library now
+# (sheeprl_tpu.telemetry.throughput) so train loops and this bench share one
+# implementation; see peak_flops_record / flops_of_lowered / mfu there.
 
 
 def record() -> dict:
@@ -150,31 +107,27 @@ def record() -> dict:
     _t_start = time.perf_counter()
 
     def _phase(msg: str) -> None:
-        print(f"[bench_dv3] t={time.perf_counter() - _t_start:.1f}s {msg}", file=sys.stderr)
+        from sheeprl_tpu.telemetry.sinks import write_event
+
+        write_event(
+            {"event": "bench_progress", "msg": msg, "t": round(time.perf_counter() - _t_start, 1)},
+            sys.stderr,
+        )
 
     _phase("setup done; lowering for cost_analysis")
 
     # model FLOPs per gradient step from the compiled program itself
     # (jit(...).lower().compile().cost_analysis(), VERDICT r3 item 1) — the
-    # basis for the MFU figure when the chip's peak is known
+    # basis for the MFU figure when the chip's peak is known. The extraction
+    # (cheap pre-compile estimate, executable fallback) is
+    # telemetry.throughput.flops_of_lowered.
+    from sheeprl_tpu.telemetry.throughput import flops_of_lowered
+
     flops_per_step = None
     try:
         tkey0 = jax.random.key(1)
-        # Lowered.cost_analysis() estimates from the lowered module WITHOUT a
-        # backend compile — the full jit compile below is the only one paid
         lowered = train.lower(params, opt_states, moments, data, jax.random.split(tkey0, 1))
-        ca = lowered.cost_analysis()
-        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        if ca and ca.get("flops"):
-            flops_per_step = float(ca["flops"])  # one call == one grad step (G=1)
-        else:
-            # some backends (the axon relay among them) only report costs on
-            # the compiled executable; the compile is the same one the warmup
-            # below pays, and the persistent cache makes it a one-time price
-            ca = lowered.compile().cost_analysis()
-            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-            if ca and ca.get("flops"):
-                flops_per_step = float(ca["flops"])
+        flops_per_step = flops_of_lowered(lowered)  # one call == one grad step (G=1)
     except Exception as err:  # cost_analysis is best-effort on some backends
         print(f"[bench] cost_analysis unavailable: {err}", file=sys.stderr)
 
@@ -247,30 +200,28 @@ def record() -> dict:
         "precision": str(cfg.fabric.precision),
     }
     if flops_per_step is not None:
+        from sheeprl_tpu.telemetry.throughput import mfu as _mfu
+        from sheeprl_tpu.telemetry.throughput import peak_flops_record
+
         rec["model_flops_per_step"] = flops_per_step
-        dev0 = jax.devices()[0]
-        peak = _peak_flops(dev0)
-        if peak is not None:
-            rec["peak_flops_basis"] = "vendor bf16 peak by device_kind"
-        elif dev0.platform == "cpu":
-            peak = _measured_peak_flops()
-            rec["peak_flops_basis"] = "measured 1024^3 f32 matmul on cpu (not vendor peak)"
-        else:
-            rec["peak_flops_basis"] = (
-                f"unknown device_kind {getattr(dev0, 'device_kind', '')!r}; mfu omitted"
-            )
+        peak_rec = peak_flops_record(jax.devices()[0])
+        rec["peak_flops_basis"] = peak_rec["peak_flops_basis"]
+        peak = peak_rec["peak_flops"]
         if peak is not None:
             # flops_per_step and sps are whole-mesh quantities; normalize the
             # peak by the device count so multi-chip runs report true MFU
             n_dev = jax.device_count()
-            rec["mfu"] = round(flops_per_step * sps / (peak * n_dev), 4)
+            rec["mfu"] = round(_mfu(flops_per_step, sps, peak, n_dev), 4)
             rec["peak_flops_assumed"] = peak
             rec["devices"] = n_dev
     return rec
 
 
 def main() -> None:
-    print(json.dumps(record()))
+    # one schema-validated JSONL line on stdout (shared with in-run telemetry)
+    from sheeprl_tpu.telemetry.sinks import write_event
+
+    write_event({"event": "bench", **record()}, sys.stdout)
 
 
 if __name__ == "__main__":

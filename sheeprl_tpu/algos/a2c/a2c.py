@@ -24,13 +24,12 @@ from ...ops import gae as gae_op
 from ...optim import clipped
 from ...parallel import Distributed
 from ...parallel.placement import make_param_mirror
+from ...telemetry import Telemetry
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
-from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...utils import run_info
-from ...utils.timer import timer
 from ...utils.utils import WallClockStopper, save_configs, wall_cap_reached
 from ..ppo.utils import prepare_obs, test
 from .agent import actions_and_log_probs, build_agent
@@ -116,9 +115,8 @@ def main(dist: Distributed, cfg: Config) -> None:
         partial(gae_op, num_steps=rollout_steps, gamma=cfg.algo.gamma, gae_lambda=cfg.algo.gae_lambda)
     )
 
-    aggregator = MetricAggregator(
-        {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
-    )
+    telem = Telemetry.setup(cfg, log_dir, rank, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
+    aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
 
     policy_steps_per_iter = num_envs * rollout_steps
@@ -144,7 +142,8 @@ def main(dist: Distributed, cfg: Config) -> None:
 
     wall = WallClockStopper(cfg)
     for update_iter in range(start_iter, num_updates + 1):
-        with timer("Time/env_interaction_time"):
+        telem.tick(policy_step)
+        with telem.span("Time/env_interaction_time"):
             for _ in range(rollout_steps):
                 device_obs = prepare_obs(obs, (), mlp_keys, num_envs)
                 player_key, act_key = jax.random.split(player_key)
@@ -188,7 +187,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                     aggregator.update("Rewards/rew_avg", ep_rew)
                     aggregator.update("Game/ep_len_avg", ep_len)
 
-        with timer("Time/train_time"):
+        with telem.span("Time/train_time"):
             local = rb.buffer
             next_value = value_fn(mirror.current(), prepare_obs(obs, (), mlp_keys, num_envs))
             returns, advantages = gae_fn(
@@ -202,21 +201,15 @@ def main(dist: Distributed, cfg: Config) -> None:
             data["advantages"] = advantages.reshape(total_batch, 1)
             data = {k: jax.device_put(v, dist.batch_sharding) for k, v in data.items()}
             params, opt_state, metrics = update(params, opt_state, data)
+            telem.record_grad_steps(1)
             mirror.refresh(params)  # blocking: next rollout acts with fresh params
             run_info.mark_steady(policy_step)
 
         for k, v in metrics.items():
             aggregator.update(k, np.asarray(v))
 
-        if rank == 0 and logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
-            logger.log_metrics(aggregator.compute(), policy_step)
-            aggregator.reset()
-            timings = timer.compute()
-            if timings.get("Time/train_time"):
-                logger.log_metrics(
-                    {"Time/sps_train": (policy_step - last_log) / timings["Time/train_time"]}, policy_step
-                )
-            timer.reset()
+        if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
+            telem.log(policy_step)
             last_log = policy_step
 
         if (
@@ -229,6 +222,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             break
 
     envs.close()
+    telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:
         test_env = vectorize(
             Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}}), cfg.seed, rank, log_dir

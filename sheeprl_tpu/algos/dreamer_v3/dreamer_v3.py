@@ -45,13 +45,13 @@ from ...optim import clipped
 from ...parallel import Distributed
 from ...parallel.mesh import maybe_shard_opt_state
 from ...parallel.placement import make_param_mirror, player_device
+from ...telemetry import Telemetry
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, patch_restarted_envs, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...utils import run_info
-from ...utils.timer import timer
 from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
 from .agent import Actor, WorldModel, build_agent, compute_stochastic_state, sample_actor_actions
 from .loss import reconstruction_loss
@@ -546,9 +546,8 @@ def main(dist: Distributed, cfg: Config) -> None:
         cfg, dist.local_device, {"wm": params["wm"], "actor": params["actor"]}, root_key
     )
 
-    aggregator = MetricAggregator(
-        {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
-    )
+    telem = Telemetry.setup(cfg, log_dir, rank, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
+    aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
@@ -610,6 +609,7 @@ def main(dist: Distributed, cfg: Config) -> None:
     _t0 = time.perf_counter()
 
     while policy_step < total_steps:
+        telem.tick(policy_step)
         if wall_cap_reached(wall, policy_step, total_steps, ckpt, _ckpt_state, cfg):
             break
         if _progress and policy_step % _progress < num_envs:
@@ -618,7 +618,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                 file=sys.stderr,
                 flush=True,
             )
-        with timer("Time/env_interaction_time"):
+        with telem.span("Time/env_interaction_time"):
             if policy_step <= learning_starts:
                 actions_env = np.stack([action_space.sample() for _ in range(num_envs)])
                 if is_continuous:
@@ -701,9 +701,10 @@ def main(dist: Distributed, cfg: Config) -> None:
 
         if policy_step >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step / dist.world_size)
+            telem.record_grad_steps(per_rank_gradient_steps)
             if per_rank_gradient_steps > 0:
                 _trace = os.environ.get("SHEEPRL_TPU_TRACE")
-                with timer("Time/train_time"):
+                with telem.span("Time/train_time"):
                     _tt = time.perf_counter()
                     batches = prefetch.take(per_rank_gradient_steps)  # [G, T, B, ...]
                     _t_take = time.perf_counter()
@@ -750,19 +751,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                 for k, v in m.items():
                     aggregator.update(k, np.asarray(v))
             pending_metrics.clear()
-            if rank == 0 and logger is not None:
-                logger.log_metrics(aggregator.compute(), policy_step)
-                timings = timer.compute()
-                if timings.get("Time/env_interaction_time"):
-                    logger.log_metrics(
-                        {
-                            "Time/sps_env_interaction": (policy_step - last_log)
-                            / timings["Time/env_interaction_time"]
-                        },
-                        policy_step,
-                    )
-            aggregator.reset()
-            timer.reset()
+            telem.log(policy_step)
             last_log = policy_step
 
         if (
@@ -772,6 +761,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             ckpt.save(policy_step, _ckpt_state())
 
     envs.close()
+    telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:
         test_cfg = Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}})
         test_env = vectorize(test_cfg, cfg.seed, rank, log_dir).envs[0]

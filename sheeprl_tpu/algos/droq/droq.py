@@ -28,12 +28,11 @@ from ...config import Config, instantiate
 from ...data import ReplayBuffer
 from ...parallel import Distributed
 from ...parallel.placement import make_param_mirror
+from ...telemetry import Telemetry
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
-from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
-from ...utils.timer import timer
 from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
 from ..sac.agent import sample_actions
 from ..sac.loss import critic_loss, entropy_loss, policy_loss
@@ -186,9 +185,8 @@ def main(dist: Distributed, cfg: Config) -> None:
         actions, _ = sample_actions(actor, mean, log_std, key)
         return actions
 
-    aggregator = MetricAggregator(
-        {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
-    )
+    telem = Telemetry.setup(cfg, log_dir, rank, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
+    aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
@@ -226,9 +224,10 @@ def main(dist: Distributed, cfg: Config) -> None:
 
     wall = WallClockStopper(cfg)
     while policy_step < total_steps:
+        telem.tick(policy_step)
         if wall_cap_reached(wall, policy_step, total_steps, ckpt, _ckpt_state, cfg):
             break
-        with timer("Time/env_interaction_time"):
+        with telem.span("Time/env_interaction_time"):
             if policy_step <= learning_starts:
                 env_actions = np.stack([action_space.sample() for _ in range(num_envs)])
             else:
@@ -267,7 +266,7 @@ def main(dist: Distributed, cfg: Config) -> None:
         if policy_step >= learning_starts:
             g = ratio(policy_step / dist.world_size)
             if g > 0:
-                with timer("Time/train_time"):
+                with telem.span("Time/train_time"):
                     sample = rb.sample(batch_size * g)
                     mb_sharding = dist.sharding(None, "dp")
                     critic_batches = {
@@ -290,10 +289,8 @@ def main(dist: Distributed, cfg: Config) -> None:
                 for k, v in metrics.items():
                     aggregator.update(k, np.asarray(v))
 
-        if rank == 0 and logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
-            logger.log_metrics(aggregator.compute(), policy_step)
-            aggregator.reset()
-            timer.reset()
+        if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
+            telem.log(policy_step)
             last_log = policy_step
 
         if (
@@ -303,6 +300,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             ckpt.save(policy_step, _ckpt_state())
 
     envs.close()
+    telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:
         test_env = vectorize(
             Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}}), cfg.seed, rank, log_dir

@@ -20,12 +20,12 @@ from ...optim import clipped
 from ...data.device_ring import estimate_row_bytes, make_sequential_prefetcher
 from ...parallel import Distributed
 from ...parallel.placement import make_param_mirror
+from ...telemetry import Telemetry
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, patch_restarted_envs, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm
-from ...utils.timer import timer
 from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
 from ..dreamer_v2.agent import build_agent as dv2_build_agent
 from ..dreamer_v2.dreamer_v2 import _build_buffer, make_player, make_train_fn
@@ -123,9 +123,8 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
         wm, actor, cfg, actions_dim, is_continuous, num_envs
     )
 
-    aggregator = MetricAggregator(
-        {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
-    )
+    telem = Telemetry.setup(cfg, log_dir, rank, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
+    aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
@@ -198,9 +197,10 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
 
     wall = WallClockStopper(cfg)
     while policy_step < total_steps:
+        telem.tick(policy_step)
         if wall_cap_reached(wall, policy_step, total_steps, ckpt, _ckpt_state, cfg):
             break
-        with timer("Time/env_interaction_time"):
+        with telem.span("Time/env_interaction_time"):
             if policy_step >= learning_starts and actor_type != "task":
                 actor_type = "task"
                 mirror.refresh(step_params())
@@ -266,8 +266,9 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
 
         if policy_step >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step / dist.world_size)
+            telem.record_grad_steps(per_rank_gradient_steps)
             if per_rank_gradient_steps > 0:
-                with timer("Time/train_time"):
+                with telem.span("Time/train_time"):
                     batches = prefetch.take(per_rank_gradient_steps)  # [G, T, B, ...]
                     root_key, sub = jax.random.split(root_key)
                     params, opt_states, metrics = train(
@@ -289,10 +290,7 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
                 for k, v in m.items():
                     aggregator.update(k, np.asarray(v))
             pending_metrics.clear()
-            if rank == 0 and logger is not None:
-                logger.log_metrics(aggregator.compute(), policy_step)
-            aggregator.reset()
-            timer.reset()
+            telem.log(policy_step)
             last_log = policy_step
 
         if (
@@ -302,6 +300,7 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
             ckpt.save(policy_step, _ckpt_state())
 
     envs.close()
+    telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:
         test_cfg = Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}})
         test_env = vectorize(test_cfg, cfg.seed, rank, log_dir).envs[0]

@@ -37,11 +37,10 @@ from ...parallel import Distributed
 from ...parallel.placement import make_param_mirror
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
+from ...telemetry import Telemetry
 from ...utils.logger import get_log_dir, get_logger
-from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...utils import run_info
-from ...utils.timer import timer
 from ...utils.utils import Ratio, WallClockStopper, linear_annealing, save_configs, wall_cap_reached
 from .agent import PPOAgent, actions_and_log_probs, build_agent
 from .loss import entropy_loss, policy_loss, value_loss
@@ -189,9 +188,8 @@ def main(dist: Distributed, cfg: Config) -> None:
     )
     gae_fn = jax.jit(partial(gae_op, num_steps=rollout_steps, gamma=cfg.algo.gamma, gae_lambda=cfg.algo.gae_lambda))
 
-    aggregator = MetricAggregator(
-        {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
-    )
+    telem = Telemetry.setup(cfg, log_dir, rank, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
+    aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
 
     # -- counters ----------------------------------------------------------
@@ -217,7 +215,8 @@ def main(dist: Distributed, cfg: Config) -> None:
 
     wall = WallClockStopper(cfg)
     for update_iter in range(start_iter, num_updates + 1):
-        with timer("Time/env_interaction_time"):
+        telem.tick(policy_step)
+        with telem.span("Time/env_interaction_time"):
             for _ in range(rollout_steps):
                 device_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
                 player_key, act_key = jax.random.split(player_key)
@@ -268,7 +267,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                     aggregator.update("Game/ep_len_avg", ep_len)
 
         # -- estimate returns (device, reverse scan) -----------------------
-        with timer("Time/train_time"):
+        with telem.span("Time/train_time"):
             local = rb.buffer  # [T, N, ...]
             # mirror params: keeps the bootstrap off the remote link (the GAE
             # scan then runs on the player device; data is tiny [T, N])
@@ -307,6 +306,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             }
             root_key, up_key = jax.random.split(root_key)
             params, opt_state, metrics = update(params, opt_state, data, coefs, up_key)
+            telem.record_grad_steps(num_minibatches * int(cfg.algo.update_epochs))
             mirror.refresh(params)  # blocking: next rollout acts with fresh params
             run_info.mark_steady(policy_step)
 
@@ -315,26 +315,8 @@ def main(dist: Distributed, cfg: Config) -> None:
                 aggregator.update(k, np.asarray(v))
 
         # -- logging -------------------------------------------------------
-        if rank == 0 and logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
-            computed = aggregator.compute()
-            logger.log_metrics(computed, policy_step)
-            aggregator.reset()
-            timings = timer.compute()
-            if timings:
-                if "Time/train_time" in timings and timings["Time/train_time"] > 0:
-                    logger.log_metrics(
-                        {"Time/sps_train": (policy_step - last_log) / timings["Time/train_time"]},
-                        policy_step,
-                    )
-                if "Time/env_interaction_time" in timings and timings["Time/env_interaction_time"] > 0:
-                    logger.log_metrics(
-                        {
-                            "Time/sps_env_interaction": (policy_step - last_log)
-                            / timings["Time/env_interaction_time"]
-                        },
-                        policy_step,
-                    )
-                timer.reset()
+        if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
+            telem.log(policy_step)
             last_log = policy_step
 
         # -- checkpoint ----------------------------------------------------
@@ -349,6 +331,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             break
 
     envs.close()
+    telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:
         test_env = vectorize(
             Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}}),

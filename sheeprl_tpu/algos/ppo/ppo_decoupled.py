@@ -39,10 +39,9 @@ from ...parallel import Distributed
 from ...parallel.placement import ParamMirror, player_device
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
+from ...telemetry import Telemetry
 from ...utils.logger import get_log_dir, get_logger
-from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm
-from ...utils.timer import timer
 from ...utils.utils import WallClockStopper, linear_annealing, save_configs, wall_cap_reached
 from .agent import build_agent
 from .ppo import make_act_fn, make_update_fn, make_value_fn
@@ -59,7 +58,7 @@ def _player_loop(
     module,
     init_params,
     log_dir: str,
-    aggregator: MetricAggregator,
+    telem: Telemetry,
     data_q: "queue.Queue",
     params_q: "queue.Queue",
     start_iter: int,
@@ -110,7 +109,7 @@ def _player_loop(
         policy_step = (start_iter - 1) * num_envs * rollout_steps
 
         for update_iter in range(start_iter, num_updates + 1):
-            with timer("Time/env_interaction_time"):
+            with telem.span("Time/env_interaction_time"):
                 for _ in range(rollout_steps):
                     device_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
                     root_key, act_key = jax.random.split(root_key)
@@ -159,8 +158,8 @@ def _player_loop(
                     obs = next_obs
 
                     for ep_rew, ep_len in episode_stats(info):
-                        aggregator.update("Rewards/rew_avg", ep_rew)
-                        aggregator.update("Game/ep_len_avg", ep_len)
+                        telem.update("Rewards/rew_avg", ep_rew)
+                        telem.update("Game/ep_len_avg", ep_len)
 
                 local = rb.buffer
                 next_value = value_fn(mirror.params, prepare_obs(obs, cnn_keys, mlp_keys, num_envs))
@@ -229,9 +228,8 @@ def main(dist: Distributed, cfg: Config) -> None:
     num_minibatches = total_batch // mb_size
     update = make_update_fn(module, tx, cfg, num_minibatches, mb_size)
 
-    aggregator = MetricAggregator(
-        {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
-    )
+    telem = Telemetry.setup(cfg, log_dir, 0, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
+    aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=True)
 
     policy_steps_per_iter = num_envs * rollout_steps
@@ -246,7 +244,7 @@ def main(dist: Distributed, cfg: Config) -> None:
         target=_player_loop,
         name="ppo-player",
         args=(
-            dist, cfg, module, params, log_dir, aggregator, data_q, params_q,
+            dist, cfg, module, params, log_dir, telem, data_q, params_q,
             start_iter, num_updates, player_key,
         ),
         daemon=True,
@@ -275,8 +273,9 @@ def main(dist: Distributed, cfg: Config) -> None:
             if isinstance(item, BaseException):
                 raise _PlayerCrashed("player thread crashed") from item
             _, policy_step, data = item
+            telem.tick(policy_step)
 
-            with timer("Time/train_time"):
+            with telem.span("Time/train_time"):
                 device_data = {
                     k: jax.device_put(v, dist.batch_sharding) for k, v in data.items()
                 }
@@ -301,30 +300,15 @@ def main(dist: Distributed, cfg: Config) -> None:
                 }
                 root_key, up_key = jax.random.split(root_key)
                 params, opt_state, metrics = update(params, opt_state, device_data, coefs, up_key)
+                telem.record_grad_steps(num_minibatches * int(cfg.algo.update_epochs))
 
             # metrics / logging / checkpoint run while the player is blocked
-            # on params_q.get() — the shared aggregator/timer are quiescent
+            # on params_q.get() (the span tracker is thread-safe regardless)
             for k, v in metrics.items():
                 aggregator.update(k, np.asarray(v))
 
-            if logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
-                logger.log_metrics(aggregator.compute(), policy_step)
-                aggregator.reset()
-                timings = timer.compute()
-                if timings.get("Time/train_time"):
-                    logger.log_metrics(
-                        {"Time/sps_train": (policy_step - last_log) / timings["Time/train_time"]},
-                        policy_step,
-                    )
-                if timings.get("Time/env_interaction_time"):
-                    logger.log_metrics(
-                        {
-                            "Time/sps_env_interaction": (policy_step - last_log)
-                            / timings["Time/env_interaction_time"]
-                        },
-                        policy_step,
-                    )
-                timer.reset()
+            if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
+                telem.log(policy_step)
                 last_log = policy_step
 
             if (
@@ -347,6 +331,7 @@ def main(dist: Distributed, cfg: Config) -> None:
         except queue.Full:
             pass
     player.join(timeout=60)
+    telem.close(policy_step)
 
     if cfg.algo.run_test:
         test_env = vectorize(

@@ -35,12 +35,11 @@ from ...ops import gae as gae_op
 from ...optim import clipped
 from ...parallel import Distributed
 from ...parallel.placement import make_param_mirror
+from ...telemetry import Telemetry
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
-from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
-from ...utils.timer import timer
 from ...utils.utils import WallClockStopper, linear_annealing, save_configs, wall_cap_reached
 from ..ppo.loss import entropy_loss, policy_loss, value_loss
 from .agent import RecurrentPPOAgent, actions_and_log_probs, build_agent
@@ -206,9 +205,8 @@ def main(dist: Distributed, cfg: Config) -> None:
         partial(gae_op, num_steps=rollout_steps, gamma=cfg.algo.gamma, gae_lambda=cfg.algo.gae_lambda)
     )
 
-    aggregator = MetricAggregator(
-        {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
-    )
+    telem = Telemetry.setup(cfg, log_dir, rank, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
+    aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
 
     policy_steps_per_iter = num_envs * rollout_steps
@@ -250,9 +248,10 @@ def main(dist: Distributed, cfg: Config) -> None:
 
     wall = WallClockStopper(cfg)
     for update_iter in range(start_iter, num_updates + 1):
+        telem.tick(policy_step)
         chunk_cx: list = []
         chunk_hx: list = []
-        with timer("Time/env_interaction_time"):
+        with telem.span("Time/env_interaction_time"):
             for t in range(rollout_steps):
                 device_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
                 player_key, act_key = jax.random.split(player_key)
@@ -323,7 +322,7 @@ def main(dist: Distributed, cfg: Config) -> None:
                     aggregator.update("Rewards/rew_avg", ep_rew)
                     aggregator.update("Game/ep_len_avg", ep_len)
 
-        with timer("Time/train_time"):
+        with telem.span("Time/train_time"):
             local = rb.buffer  # [T, N, ...]
             # mirror params: the recurrent carry lives on the player device,
             # and mixing it with mesh-committed params would be a device clash
@@ -388,30 +387,14 @@ def main(dist: Distributed, cfg: Config) -> None:
             }
             root_key, up_key = jax.random.split(root_key)
             params, opt_state, metrics = update(params, opt_state, data, coefs, up_key)
+            telem.record_grad_steps(num_minibatches * int(cfg.algo.update_epochs))
             mirror.refresh(params)  # blocking: next rollout acts with fresh params
 
         for k, v in metrics.items():
             aggregator.update(k, np.asarray(v))
 
-        if rank == 0 and logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
-            logger.log_metrics(aggregator.compute(), policy_step)
-            aggregator.reset()
-            timings = timer.compute()
-            if timings:
-                if timings.get("Time/train_time"):
-                    logger.log_metrics(
-                        {"Time/sps_train": (policy_step - last_log) / timings["Time/train_time"]},
-                        policy_step,
-                    )
-                if timings.get("Time/env_interaction_time"):
-                    logger.log_metrics(
-                        {
-                            "Time/sps_env_interaction": (policy_step - last_log)
-                            / timings["Time/env_interaction_time"]
-                        },
-                        policy_step,
-                    )
-                timer.reset()
+        if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
+            telem.log(policy_step)
             last_log = policy_step
 
         if (
@@ -424,6 +407,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             break
 
     envs.close()
+    telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:
         test_env = vectorize(
             Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}}),

@@ -30,13 +30,13 @@ from ...data import ReplayBuffer
 from ...data.device_ring import estimate_row_bytes, make_uniform_prefetcher
 from ...parallel import Distributed
 from ...parallel.placement import make_param_mirror
+from ...telemetry import Telemetry
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...utils import run_info
-from ...utils.timer import timer
 from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
 from .agent import SACActor, build_agent, sample_actions
 from .loss import critic_loss, entropy_loss, policy_loss
@@ -181,9 +181,8 @@ def main(dist: Distributed, cfg: Config) -> None:
         actions, _ = sample_actions(actor, mean, log_std, key)
         return actions
 
-    aggregator = MetricAggregator(
-        {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
-    )
+    telem = Telemetry.setup(cfg, log_dir, rank, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
+    aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
@@ -233,9 +232,10 @@ def main(dist: Distributed, cfg: Config) -> None:
 
     wall = WallClockStopper(cfg)
     while policy_step < total_steps:
+        telem.tick(policy_step)
         if wall_cap_reached(wall, policy_step, total_steps, ckpt, _ckpt_state, cfg):
             break
-        with timer("Time/env_interaction_time"):
+        with telem.span("Time/env_interaction_time"):
             if policy_step <= learning_starts:
                 env_actions = np.stack([action_space.sample() for _ in range(num_envs)])
             else:
@@ -272,8 +272,9 @@ def main(dist: Distributed, cfg: Config) -> None:
 
         if policy_step >= learning_starts:
             per_rank_gradient_steps = ratio(policy_step / dist.world_size)
+            telem.record_grad_steps(per_rank_gradient_steps)
             if per_rank_gradient_steps > 0:
-                with timer("Time/train_time"):
+                with telem.span("Time/train_time"):
                     batches = prefetch.take(per_rank_gradient_steps)  # [G, B, ...]
                     root_key, sub = jax.random.split(root_key)
                     keys = jax.random.split(sub, per_rank_gradient_steps)
@@ -293,20 +294,12 @@ def main(dist: Distributed, cfg: Config) -> None:
                 for k, v in m.items():
                     aggregator.update(k, np.asarray(v))
             pending_metrics.clear()
-            if rank == 0 and logger is not None:
-                logger.log_metrics(aggregator.compute(), policy_step)
-                timings = timer.compute()
-                if timings.get("Time/train_time"):
-                    logger.log_metrics(
-                        {"Time/sps_train": (policy_step - last_log) / timings["Time/train_time"]}, policy_step
-                    )
-                if policy_step > 0:
-                    logger.log_metrics(
-                        {"Params/replay_ratio": cumulative_grad_steps * dist.world_size / policy_step},
-                        policy_step,
-                    )
-            aggregator.reset()
-            timer.reset()
+            telem.log(
+                policy_step,
+                extra_metrics={"Params/replay_ratio": cumulative_grad_steps * dist.world_size / policy_step}
+                if policy_step > 0
+                else None,
+            )
             last_log = policy_step
 
         if (
@@ -316,6 +309,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             ckpt.save(policy_step, _ckpt_state())
 
     envs.close()
+    telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:
         test_env = vectorize(
             Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}}), cfg.seed, rank, log_dir

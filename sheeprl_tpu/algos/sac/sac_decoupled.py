@@ -29,10 +29,9 @@ from ...parallel import Distributed
 from ...parallel.placement import ParamMirror, player_device
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
+from ...telemetry import Telemetry
 from ...utils.logger import get_log_dir, get_logger
-from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm
-from ...utils.timer import timer
 from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
 from .agent import build_agent, sample_actions
 from .sac import make_train_fn
@@ -48,7 +47,7 @@ def _player_loop(
     actor,
     init_actor_params,
     log_dir: str,
-    aggregator: MetricAggregator,
+    telem: Telemetry,
     data_q: "queue.Queue",
     params_q: "queue.Queue",
     batch_size: int,
@@ -110,7 +109,7 @@ def _player_loop(
                 wall, policy_step, total_steps, None, None, cfg, save=False
             ):
                 break
-            with timer("Time/env_interaction_time"):
+            with telem.span("Time/env_interaction_time"):
                 if policy_step <= learning_starts:
                     env_actions = np.stack([action_space.sample() for _ in range(num_envs)])
                 else:
@@ -143,8 +142,8 @@ def _player_loop(
                 obs_vec = flatten_obs(next_obs, mlp_keys, num_envs)
 
                 for ep_rew, ep_len in episode_stats(info):
-                    aggregator.update("Rewards/rew_avg", ep_rew)
-                    aggregator.update("Game/ep_len_avg", ep_len)
+                    telem.update("Rewards/rew_avg", ep_rew)
+                    telem.update("Game/ep_len_avg", ep_len)
 
             if policy_step >= learning_starts:
                 per_rank_gradient_steps = ratio(policy_step / world_size)
@@ -218,9 +217,8 @@ def main(dist: Distributed, cfg: Config) -> None:
     train = make_train_fn(actor, critic, txs, cfg, target_entropy)
     batch_size = int(cfg.algo.per_rank_batch_size) * dist.world_size
 
-    aggregator = MetricAggregator(
-        {k: v for k, v in (cfg.select("metric.aggregator.metrics") or {}).items() if k in AGGREGATOR_KEYS}
-    )
+    telem = Telemetry.setup(cfg, log_dir, 0, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
+    aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=True)
     last_log = state["last_log"] if state else 0
     last_checkpoint = state["last_checkpoint"] if state else 0
@@ -233,7 +231,7 @@ def main(dist: Distributed, cfg: Config) -> None:
         target=_player_loop,
         name="sac-player",
         args=(
-            cfg, actor, params["actor"], log_dir, aggregator, data_q, params_q,
+            cfg, actor, params["actor"], log_dir, telem, data_q, params_q,
             batch_size, dist.world_size, state, player_key, wall,
         ),
         daemon=True,
@@ -267,8 +265,9 @@ def main(dist: Distributed, cfg: Config) -> None:
             if isinstance(item, BaseException):
                 raise _PlayerCrashed("player thread crashed") from item
             policy_step, G, batches, ratio_state, rb = item
+            telem.tick(policy_step)
 
-            with timer("Time/train_time"):
+            with telem.span("Time/train_time"):
                 mb_sharding = dist.sharding(None, "dp")
                 device_batches = {
                     k: jax.device_put(v, mb_sharding) for k, v in batches.items()
@@ -276,29 +275,23 @@ def main(dist: Distributed, cfg: Config) -> None:
                 root_key, sub = jax.random.split(root_key)
                 keys = jax.random.split(sub, G)
                 params, opt_states, metrics = train(params, opt_states, device_batches, keys)
+                telem.record_grad_steps(G)
                 cumulative_grad_steps += G
 
             # metrics / logging / checkpoint happen HERE, while the player is
-            # still blocked on params_q.get(): the shared aggregator/timer and
-            # the player-owned buffer are quiescent, so snapshots are
-            # consistent (no torn rb.state_dict, no racing timer.reset)
+            # still blocked on params_q.get(): the player-owned buffer is
+            # quiescent, so snapshots are consistent (no torn rb.state_dict;
+            # the span tracker is thread-safe regardless)
             for k, v in metrics.items():
                 aggregator.update(k, np.asarray(v))
 
-            if logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
-                logger.log_metrics(aggregator.compute(), policy_step)
-                aggregator.reset()
-                timings = timer.compute()
-                if timings.get("Time/train_time"):
-                    logger.log_metrics(
-                        {"Time/sps_train": (policy_step - last_log) / timings["Time/train_time"]},
-                        policy_step,
-                    )
-                if policy_step > 0:
-                    logger.log_metrics(
-                        {"Params/replay_ratio": cumulative_grad_steps / policy_step}, policy_step
-                    )
-                timer.reset()
+            if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
+                telem.log(
+                    policy_step,
+                    extra_metrics={"Params/replay_ratio": cumulative_grad_steps / policy_step}
+                    if policy_step > 0
+                    else None,
+                )
                 last_log = policy_step
 
             if (
@@ -322,6 +315,7 @@ def main(dist: Distributed, cfg: Config) -> None:
         except queue.Full:
             pass
     player.join(timeout=60)
+    telem.close(policy_step)
 
     # final checkpoint (reference :322-338 on_checkpoint_player save_last);
     # runs after player.join, so the buffer snapshot is quiescent

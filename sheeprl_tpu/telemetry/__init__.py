@@ -1,0 +1,57 @@
+"""Unified telemetry subsystem: spans, XLA health counters, throughput/MFU,
+and a JSONL event stream (see `howto/telemetry.md`).
+
+The `Telemetry` facade replaces the per-loop `timer` + `MetricAggregator` +
+`TensorBoardLogger` plumbing; the legacy `utils.timer` API remains as a shim
+over `telemetry.spans`.
+"""
+from .facade import Telemetry
+from .schema import EVENT_SCHEMAS, SCHEMA_VERSION, validate_event, validate_jsonl
+from .sinks import ConsoleHeartbeat, JsonlSink, write_event
+from .spans import GLOBAL_TRACKER, Span, SpanTracker
+from .throughput import (
+    PEAK_FLOPS,
+    ThroughputTracker,
+    flops_of_lowered,
+    measured_cpu_peak_flops,
+    mfu,
+    peak_flops_for,
+    peak_flops_record,
+)
+from .xla import (
+    RETRACE_DETECTOR,
+    TRANSFER_COUNTER,
+    RetraceDetector,
+    TransferCounter,
+    compile_counters,
+    device_memory_stats,
+    instrument,
+)
+
+__all__ = [
+    "Telemetry",
+    "EVENT_SCHEMAS",
+    "SCHEMA_VERSION",
+    "validate_event",
+    "validate_jsonl",
+    "ConsoleHeartbeat",
+    "JsonlSink",
+    "write_event",
+    "GLOBAL_TRACKER",
+    "Span",
+    "SpanTracker",
+    "PEAK_FLOPS",
+    "ThroughputTracker",
+    "flops_of_lowered",
+    "measured_cpu_peak_flops",
+    "mfu",
+    "peak_flops_for",
+    "peak_flops_record",
+    "RETRACE_DETECTOR",
+    "TRANSFER_COUNTER",
+    "RetraceDetector",
+    "TransferCounter",
+    "compile_counters",
+    "device_memory_stats",
+    "instrument",
+]

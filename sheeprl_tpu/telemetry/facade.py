@@ -1,0 +1,337 @@
+"""The `Telemetry` facade — one object per train loop that owns metric
+aggregation, span timing, XLA health counters, throughput/MFU accounting and
+every sink (TensorBoard, JSONL event stream, console heartbeat).
+
+Loops use five calls:
+
+    telem = Telemetry.setup(cfg, log_dir, rank, logger=logger,
+                            aggregator_keys=AGGREGATOR_KEYS)
+    telem.tick(policy_step)                  # top of each iteration:
+                                             # StepTraceAnnotation + windowed
+                                             # profiler capture
+    with telem.span("Time/train_time"): ...  # host span + device TraceAnnotation
+    telem.record_grad_steps(n)               # throughput accounting
+    telem.log(policy_step)                   # flush one log interval
+    telem.close()                            # end-of-run summary event
+
+`telem.aggregator` is a real `MetricAggregator`, so existing
+``aggregator.update(...)`` call sites keep working unchanged, and the legacy
+`utils.timer` shim drains into the same span tracker this facade reads.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+from ..utils.metric import MetricAggregator
+from . import xla as _xla
+from .sinks import ConsoleHeartbeat, JsonlSink
+from .spans import GLOBAL_TRACKER, Span, SpanTracker
+from .schema import SCHEMA_VERSION
+from .throughput import ThroughputTracker, peak_flops_record
+
+
+def _device_info() -> Dict[str, Any]:
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        return {
+            "platform": str(dev.platform),
+            "device_kind": str(getattr(dev, "device_kind", "")),
+            "devices": int(jax.device_count()),
+        }
+    except Exception:
+        return {"platform": "unknown", "device_kind": "", "devices": 0}
+
+
+class Telemetry:
+    """Unified observability facade for one training loop."""
+
+    def __init__(
+        self,
+        cfg: Any = None,
+        log_dir: Optional[str] = None,
+        rank: int = 0,
+        logger: Any = None,
+        aggregator_keys: Any = None,
+        tracker: Optional[SpanTracker] = None,
+    ) -> None:
+        sel = (lambda p, d=None: cfg.select(p, d)) if cfg is not None else (lambda p, d=None: d)
+        self.rank = int(rank)
+        self.log_dir = log_dir
+        self.logger = logger
+        log_level = sel("metric.log_level", 1)
+        self.enabled = bool(sel("metric.telemetry.enabled", True)) and (log_level or 0) > 0
+        # `metric.disable_timer` (benchmark configs) strips span timing
+        # overhead from the hot loop, exactly as it did for the legacy timer
+        self._span_enabled = not bool(sel("metric.disable_timer", False))
+        self.tracker = tracker if tracker is not None else GLOBAL_TRACKER
+        # a previous in-process run (p2e exploration → finetuning, tests) may
+        # have left undrained spans in the shared tracker; start clean
+        self.tracker.compute(reset=True)
+        self.throughput = ThroughputTracker(world_size=int(sel("fabric.devices", 1) or 1))
+        self.detector = _xla.RETRACE_DETECTOR
+
+        metrics_cfg = sel("metric.aggregator.metrics") or {}
+        if aggregator_keys is not None:
+            metrics_cfg = {k: v for k, v in metrics_cfg.items() if k in aggregator_keys}
+        self.aggregator = MetricAggregator(metrics_cfg)
+
+        self._info = _device_info()
+        self._info.update(
+            rank=self.rank,
+            world_size=int(sel("fabric.devices", 1) or 1),
+            algo=str(sel("algo.name", "") or ""),
+            run_name=str(sel("run_name", "") or ""),
+        )
+
+        # sinks — JSONL only on rank 0 (one stream per run, not per host)
+        self.jsonl: Optional[JsonlSink] = None
+        if self.enabled and self.rank == 0 and log_dir and bool(sel("metric.telemetry.jsonl", True)):
+            self.jsonl = JsonlSink(os.path.join(log_dir, "telemetry.jsonl"))
+        # the startup heartbeat is intentionally independent of log_level:
+        # a run degraded to cpu-fallback must say so even with metrics off
+        hb_on = bool(sel("metric.telemetry.heartbeat", True))
+        self.heartbeat = ConsoleHeartbeat(rank=self.rank, enabled=hb_on)
+
+        # XLA health baselines: report per-run deltas of process-wide counters
+        self._xla0 = _xla.compile_counters()
+        self._xla_last = dict(self._xla0)
+        self._retrace0 = self.detector.retrace_count()
+        self._attr_seen = len(self.detector.attribution())
+
+        self._transfers: Optional[_xla.TransferCounter] = None
+        if self.enabled and bool(sel("metric.telemetry.transfer_counter", True)):
+            self._transfers = _xla.TRANSFER_COUNTER
+            self._transfers.install()
+            self._transfers0 = self._transfers.snapshot()
+
+        # step annotation + windowed profiler capture
+        self._annotate_steps = self.enabled and bool(sel("metric.telemetry.step_annotation", True))
+        self._step_ann: Any = None
+        self.trace_every = int(sel("metric.telemetry.trace_every", 0) or 0) if self.enabled else 0
+        self.trace_window = int(sel("metric.telemetry.trace_window", 256) or 256)
+        self.trace_dir = str(
+            sel("metric.telemetry.trace_dir")
+            or (os.path.join(log_dir, "xprof") if log_dir else "logs/xprof")
+        )
+        self._tracing = False
+        self._trace_start_step = 0
+        self._last_trace_step = 0
+        self._closed = False
+
+        self.heartbeat.startup(self._info)
+        self._emit({"event": "startup", "schema_version": SCHEMA_VERSION, **self._info})
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def setup(
+        cls,
+        cfg: Any,
+        log_dir: Optional[str],
+        rank: int = 0,
+        logger: Any = None,
+        aggregator_keys: Any = None,
+    ) -> "Telemetry":
+        return cls(cfg, log_dir, rank, logger=logger, aggregator_keys=aggregator_keys)
+
+    # -- sinks -------------------------------------------------------------
+    def _emit(self, rec: Dict[str, Any]) -> None:
+        if self.jsonl is not None:
+            self.jsonl.write(rec)
+
+    # -- spans / annotations ----------------------------------------------
+    def span(self, name: str) -> Span:
+        return Span(name, tracker=self.tracker, enabled=self._span_enabled, annotate=self.enabled)
+
+    def tick(self, policy_step: int) -> None:
+        """Call at the top of each loop iteration: rotates the
+        `jax.profiler.StepTraceAnnotation` so XProf groups device activity by
+        policy step, and opens/closes the windowed on-demand trace capture."""
+        if self._step_ann is not None:
+            self._exit_step_ann()
+        if self._annotate_steps:
+            try:
+                import jax.profiler as prof
+
+                self._step_ann = prof.StepTraceAnnotation("train", step_num=int(policy_step))
+                self._step_ann.__enter__()
+            except Exception:
+                self._step_ann = None
+        if self.trace_every > 0:
+            self._windowed_trace(int(policy_step))
+
+    def _exit_step_ann(self) -> None:
+        try:
+            self._step_ann.__exit__(None, None, None)
+        except Exception:
+            pass
+        self._step_ann = None
+
+    def _windowed_trace(self, policy_step: int) -> None:
+        try:
+            import jax.profiler as prof
+
+            if not self._tracing and policy_step - self._last_trace_step >= self.trace_every:
+                prof.start_trace(self.trace_dir)
+                self._tracing = True
+                self._trace_start_step = policy_step
+                self._emit(
+                    {"event": "trace", "step": policy_step, "action": "started", "trace_dir": self.trace_dir}
+                )
+            elif self._tracing and policy_step - self._trace_start_step >= self.trace_window:
+                prof.stop_trace()
+                self._tracing = False
+                # gap measured from the STOP: trace_window >= trace_every must
+                # still pause trace_every steps between captures, not restart
+                # immediately (continuous profiling)
+                self._last_trace_step = policy_step
+                self._emit(
+                    {"event": "trace", "step": policy_step, "action": "stopped", "trace_dir": self.trace_dir}
+                )
+        except Exception:
+            # an already-active outer trace (cli profiler) or an unsupported
+            # backend must never kill training
+            self._tracing = False
+
+    # -- metric / throughput recording ------------------------------------
+    def update(self, name: str, value: Any) -> None:
+        self.aggregator.update(name, value)
+
+    def record_grad_steps(self, n: int) -> None:
+        self.throughput.record_grad_steps(n)
+
+    def set_model_flops(self, flops: Optional[float]) -> None:
+        """Register per-grad-step model FLOPs (e.g. from
+        `throughput.flops_of_lowered`); enables in-run MFU in log records."""
+        if flops is None:
+            return
+        try:
+            import jax
+
+            rec = peak_flops_record(jax.devices()[0])
+            self.throughput.set_model_flops(flops, rec.get("peak_flops"), jax.device_count())
+        except Exception:
+            self.throughput.set_model_flops(flops)
+
+    def instrument(self, fn: Any, name: Optional[str] = None) -> Any:
+        """Wrap a python callable before `jax.jit` so retraces are counted
+        and attributed (see `telemetry.xla.RetraceDetector`)."""
+        return self.detector.wrap(fn, name)
+
+    # -- health snapshots --------------------------------------------------
+    def xla_health(self) -> Dict[str, Any]:
+        now = _xla.compile_counters()
+        out: Dict[str, Any] = {
+            "compile_count": now["compile_count"] - self._xla0["compile_count"],
+            "compile_seconds": round(now["compile_seconds"] - self._xla0["compile_seconds"], 4),
+            "jaxpr_traces": now["jaxpr_trace_count"] - self._xla0["jaxpr_trace_count"],
+            "compiles_in_interval": now["compile_count"] - self._xla_last["compile_count"],
+            "retraces": self.detector.retrace_count() - self._retrace0,
+        }
+        self._xla_last = now
+        attribution = self.detector.attribution()
+        if len(attribution) > self._attr_seen:
+            out["retrace_attribution"] = attribution[self._attr_seen :]
+            self._attr_seen = len(attribution)
+        if self._transfers is not None:
+            snap = self._transfers.snapshot()
+            out["h2d_calls"] = snap["h2d_calls"] - self._transfers0["h2d_calls"]
+            out["h2d_bytes"] = snap["h2d_bytes"] - self._transfers0["h2d_bytes"]
+        return out
+
+    # -- the log interval --------------------------------------------------
+    def log(self, policy_step: int, extra_metrics: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Flush one log interval: drain spans + aggregator, compute SPS /
+        grad-SPS / MFU, snapshot XLA health + device memory, and write every
+        sink. Always drains (so disabled/rank>0 loops don't accumulate);
+        only writes sinks when active."""
+        spans = self.tracker.compute(reset=True)
+        metrics = self.aggregator.compute()
+        self.aggregator.reset()
+        tp = self.throughput.mark(int(policy_step))
+        if not self.enabled:
+            return {}
+        if extra_metrics:
+            metrics = {**metrics, **{k: float(v) for k, v in extra_metrics.items()}}
+        interval_steps = tp.pop("interval_steps", 0)
+        tp_seconds = tp.pop("interval_seconds", 0.0)
+        xla_health = self.xla_health()
+        memory = _xla.device_memory_stats()
+
+        scalars: Dict[str, float] = dict(metrics)
+        scalars["Time/sps"] = tp["sps"]
+        if tp.get("grad_steps_per_s"):
+            scalars["Time/grad_steps_per_s"] = tp["grad_steps_per_s"]
+        if tp.get("replay_ratio") is not None:
+            scalars["Time/replay_ratio"] = tp["replay_ratio"]
+        if tp.get("mfu") is not None:
+            scalars["Time/mfu"] = tp["mfu"]
+        for name, secs in spans.items():
+            scalars[name] = secs
+        # historical derived metrics, kept under their original names
+        train_t = spans.get("Time/train_time")
+        if train_t and interval_steps > 0:
+            scalars["Time/sps_train"] = interval_steps / train_t
+        env_t = spans.get("Time/env_interaction_time")
+        if env_t and interval_steps > 0:
+            scalars["Time/sps_env_interaction"] = interval_steps / env_t
+        for key in ("compile_count", "compile_seconds", "retraces"):
+            scalars[f"XLA/{key}"] = float(xla_health.get(key) or 0)
+        for key, val in memory.items():
+            scalars[f"Memory/{key}"] = float(val)
+
+        if self.logger is not None and self.rank == 0:
+            self.logger.log_metrics(scalars, int(policy_step))
+
+        rec: Dict[str, Any] = {
+            "event": "log",
+            "step": int(policy_step),
+            "t": round(time.time(), 3),
+            "sps": round(tp["sps"], 4),
+            "interval_steps": int(interval_steps),
+            "interval_seconds": round(tp_seconds, 4),
+            "metrics": {k: round(float(v), 6) for k, v in metrics.items()},
+            "spans": {k: round(v, 6) for k, v in spans.items()},
+            "throughput": {k: round(float(v), 6) for k, v in tp.items()},
+            "xla": xla_health,
+            "memory": memory,
+        }
+        self._emit(rec)
+        if self.rank == 0:  # startup prints per host; interval lines rank-0 only
+            self.heartbeat.log(int(policy_step), {**tp, "xla": xla_health})
+        return rec
+
+    # -- shutdown ----------------------------------------------------------
+    def close(self, policy_step: int = 0) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._step_ann is not None:
+            self._exit_step_ann()
+        if self._tracing:
+            try:
+                import jax.profiler as prof
+
+                prof.stop_trace()
+            except Exception:
+                pass
+            self._tracing = False
+        if self.enabled:
+            self._emit(
+                {
+                    "event": "shutdown",
+                    "step": int(policy_step),
+                    "xla": self.xla_health(),
+                    "spans": self.tracker.compute(),
+                    "total_grad_steps": self.throughput.total_grad_steps,
+                }
+            )
+        if self._transfers is not None:
+            self._transfers.uninstall()
+            self._transfers = None
+        if self.jsonl is not None:
+            self.jsonl.close()
+            self.jsonl = None

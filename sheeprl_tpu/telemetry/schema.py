@@ -1,0 +1,124 @@
+"""The telemetry event schema: one JSON object per line (JSONL).
+
+Every record is ``{"event": <type>, ...}``. The same schema covers in-run
+telemetry (`telemetry.jsonl` in the run's log dir), the TensorBoard-less
+metric fallback, and the BENCH_*.json artifacts the bench driver emits — one
+machine-readable format end to end.
+
+`validate_event` is deliberately dependency-free (no jsonschema): required
+keys + type checks per event type, unknown extra keys allowed (forward
+compatible).
+"""
+from __future__ import annotations
+
+import json
+import numbers
+from typing import Any, Dict, List, Tuple
+
+SCHEMA_VERSION = 1
+
+_NUM = numbers.Number
+_STR = str
+_DICT = dict
+
+# event type → {field: (required, type)}
+EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
+    # emitted once at Telemetry.setup: the record that makes cpu-fallback
+    # impossible to miss
+    "startup": {
+        "platform": (True, _STR),
+        "device_kind": (True, _STR),
+        "devices": (True, _NUM),
+        "rank": (True, _NUM),
+        "world_size": (False, _NUM),
+        "algo": (False, _STR),
+        "run_name": (False, _STR),
+        "schema_version": (False, _NUM),
+    },
+    # one per log interval
+    "log": {
+        "step": (True, _NUM),
+        "sps": (False, _NUM),
+        "metrics": (False, _DICT),
+        "spans": (False, _DICT),
+        "xla": (False, _DICT),
+        "memory": (False, _DICT),
+        "throughput": (False, _DICT),
+    },
+    # end-of-run summary
+    "shutdown": {
+        "step": (True, _NUM),
+        "xla": (False, _DICT),
+        "spans": (False, _DICT),
+    },
+    # TensorBoardLogger fallback stream (satellite: metrics never dropped)
+    "metrics": {
+        "step": (True, _NUM),
+        "metrics": (True, _DICT),
+    },
+    # bench driver records (BENCH_*.json contract: metric/value/unit/
+    # vs_baseline; platform/device_kind/wall_capped/mfu ride along)
+    "bench": {
+        "metric": (True, _STR),
+        "value": (True, _NUM),
+        "unit": (True, _STR),
+        "vs_baseline": (True, _NUM),
+        "platform": (False, _STR),
+        "device_kind": (False, _STR),
+        "wall_capped": (False, bool),
+        "mfu": (False, _NUM),
+    },
+    # bench pacing/diagnostic lines (stderr)
+    "bench_progress": {
+        "msg": (True, _STR),
+    },
+    # windowed profiler capture markers
+    "trace": {
+        "step": (True, _NUM),
+        "action": (True, _STR),  # started | stopped
+        "trace_dir": (False, _STR),
+    },
+}
+
+
+def validate_event(rec: Any) -> List[str]:
+    """Return a list of problems (empty == valid)."""
+    errors: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, expected dict"]
+    event = rec.get("event")
+    if not isinstance(event, str):
+        return ["missing 'event' field"]
+    schema = EVENT_SCHEMAS.get(event)
+    if schema is None:
+        return [f"unknown event type {event!r} (known: {sorted(EVENT_SCHEMAS)})"]
+    for field, (required, typ) in schema.items():
+        if field not in rec:
+            if required:
+                errors.append(f"{event}: missing required field '{field}'")
+            continue
+        val = rec[field]
+        if typ is _NUM and isinstance(val, bool):
+            errors.append(f"{event}: field '{field}' is bool, expected number")
+        elif not isinstance(val, typ):
+            errors.append(
+                f"{event}: field '{field}' is {type(val).__name__}, expected {typ.__name__}"
+            )
+    return errors
+
+
+def validate_jsonl(path: Any) -> List[str]:
+    """Validate a whole JSONL file; returns per-line problems."""
+    errors: List[str] = []
+    with open(path) as fh:
+        for i, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as err:
+                errors.append(f"line {i}: not JSON ({err})")
+                continue
+            errors.extend(f"line {i}: {e}" for e in validate_event(rec))
+    return errors

@@ -1,0 +1,103 @@
+"""Telemetry sinks: JSONL event stream, console heartbeat, TensorBoard.
+
+The TensorBoard sink is the existing `utils.logger` backend (passed into the
+facade); this module owns the two new ones plus the shared one-line event
+writer the bench scripts use so BENCH artifacts and in-run telemetry share a
+schema.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from typing import Any, Dict, IO, Optional
+
+from .schema import validate_event
+
+
+def write_event(rec: Dict[str, Any], stream: Optional[IO[str]] = None, strict: bool = False) -> Dict[str, Any]:
+    """Validate and write one event as a single JSONL line.
+
+    Invalid records are written anyway with a stderr note (telemetry must
+    never take down a run) unless ``strict=True``.
+    """
+    errors = validate_event(rec)
+    if errors:
+        if strict:
+            raise ValueError(f"invalid telemetry event: {errors}")
+        print(f"[telemetry] schema warning: {errors}", file=sys.stderr)
+    out = stream if stream is not None else sys.stdout
+    out.write(json.dumps(rec) + "\n")
+    try:
+        out.flush()
+    except Exception:
+        pass
+    return rec
+
+
+class JsonlSink:
+    """Append-only newline-delimited JSON event file (thread-safe)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh: Optional[IO[str]] = open(path, "a")
+
+    def write(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            if self._fh is None:
+                return
+            write_event(rec, self._fh)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
+
+
+class ConsoleHeartbeat:
+    """Rank-aware console heartbeat.
+
+    Prints one startup line with platform/device_kind — the in-run signal
+    whose absence let a whole bench round silently degrade to cpu-fallback —
+    and a compact line per log interval.
+    """
+
+    def __init__(self, rank: int = 0, enabled: bool = True, stream: Optional[IO[str]] = None) -> None:
+        self.rank = rank
+        self.enabled = enabled
+        self._stream = stream
+
+    def _out(self) -> IO[str]:
+        return self._stream if self._stream is not None else sys.stderr
+
+    def startup(self, info: Dict[str, Any]) -> None:
+        if not self.enabled:
+            return
+        print(
+            f"[telemetry rank={self.rank}] platform={info.get('platform')} "
+            f"device_kind={info.get('device_kind')!r} devices={info.get('devices')} "
+            f"algo={info.get('algo')}",
+            file=self._out(),
+            flush=True,
+        )
+
+    def log(self, step: int, fields: Dict[str, Any]) -> None:
+        if not self.enabled:
+            return
+        parts = [f"step={step}"]
+        for key in ("sps", "grad_steps_per_s", "mfu"):
+            val = fields.get(key)
+            if val is not None:
+                parts.append(f"{key}={val:.3g}")
+        xla = fields.get("xla") or {}
+        if xla.get("compile_count"):
+            parts.append(f"compiles={int(xla['compile_count'])}")
+        if xla.get("retraces"):
+            parts.append(f"retraces={int(xla['retraces'])}")
+        print(f"[telemetry rank={self.rank}] " + " ".join(parts), file=self._out(), flush=True)

@@ -1,0 +1,161 @@
+"""Throughput accounting: SPS, grad-steps/s, replay ratio, model FLOPs, MFU.
+
+This is the MFU / model-FLOPs math that previously lived only in
+`bench_dv3.py` — promoted into the library so train loops can report
+utilization in-run and the bench scripts share one implementation.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+# peak dense-matmul FLOP/s per chip by device kind (bf16 for TPUs — the MXU's
+# native precision and the standard MFU convention). Substring-matched.
+PEAK_FLOPS: Dict[str, float] = {
+    "v6": 918e12,  # Trillium
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def peak_flops_for(device: Any) -> Optional[float]:
+    """Vendor bf16 peak FLOP/s for a device, by `device_kind` substring."""
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for sub, peak in PEAK_FLOPS.items():
+        if sub in kind:
+            return peak
+    return None
+
+
+def measured_cpu_peak_flops() -> float:
+    """Achievable dense-matmul FLOP/s on the host CPU backend, measured with
+    a jitted 1024³ f32 matmul (best of 5) — the MFU denominator on fallback
+    runs, so utilization is recorded on every path (labeled as measured, not
+    vendor peak). CPU-only: on a fast unknown accelerator a 2.1 GFLOP matmul
+    would be latency-dominated and overstate MFU."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 1024
+    x = jnp.ones((n, n), jnp.float32)
+    f = jax.jit(lambda a: a @ a)
+    jax.block_until_ready(f(x))
+
+    def _one() -> float:
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(x))
+        return time.perf_counter() - t0
+
+    return 2 * n**3 / min(_one() for _ in range(5))
+
+
+def flops_of_lowered(lowered: Any) -> Optional[float]:
+    """Model FLOPs per call from `jit(...).lower(...)`: try the cheap
+    pre-compile `cost_analysis()`, fall back to compiling (some backends only
+    report costs on the executable — the persistent compilation cache makes
+    that a one-time price)."""
+    try:
+        ca = lowered.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        if ca and ca.get("flops"):
+            return float(ca["flops"])
+        ca = lowered.compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        if ca and ca.get("flops"):
+            return float(ca["flops"])
+    except Exception:
+        pass
+    return None
+
+
+def mfu(flops_per_step: float, steps_per_sec: float, peak_flops: float, n_devices: int = 1) -> float:
+    """Model FLOPs utilization. `flops_per_step` and `steps_per_sec` are
+    whole-mesh quantities; the peak is per chip, so normalize by device
+    count."""
+    return flops_per_step * steps_per_sec / (peak_flops * max(1, n_devices))
+
+
+def peak_flops_record(device: Any, allow_cpu_measure: bool = True) -> Dict[str, Any]:
+    """{peak_flops, peak_flops_basis} for a device — vendor table first,
+    measured host matmul on CPU, neither on unknown accelerators."""
+    peak = peak_flops_for(device)
+    if peak is not None:
+        return {"peak_flops": peak, "peak_flops_basis": "vendor bf16 peak by device_kind"}
+    if getattr(device, "platform", "") == "cpu" and allow_cpu_measure:
+        return {
+            "peak_flops": measured_cpu_peak_flops(),
+            "peak_flops_basis": "measured 1024^3 f32 matmul on cpu (not vendor peak)",
+        }
+    return {
+        "peak_flops": None,
+        "peak_flops_basis": (
+            f"unknown device_kind {getattr(device, 'device_kind', '')!r}; mfu omitted"
+        ),
+    }
+
+
+class ThroughputTracker:
+    """Interval accounting for one train loop: policy steps, gradient steps
+    and wall time between `mark()` calls → SPS / grad-steps-per-sec / replay
+    ratio, plus MFU when the loop registered its per-grad-step model FLOPs."""
+
+    def __init__(self, start_step: int = 0, world_size: int = 1) -> None:
+        self._lock = threading.Lock()
+        self._last_step = int(start_step)
+        self._last_time = time.perf_counter()
+        self._grad_steps = 0
+        self._total_grad_steps = 0
+        # loops record PER-RANK gradient steps (the reference convention:
+        # ratio(policy_step / world_size)); replay_ratio re-scales by
+        # world_size so the reported figure matches the configured knob
+        self.world_size = max(1, int(world_size))
+        self.model_flops_per_step: Optional[float] = None
+        self.peak_flops: Optional[float] = None
+        self.n_devices: int = 1
+
+    def record_grad_steps(self, n: int) -> None:
+        with self._lock:
+            self._grad_steps += int(n)
+            self._total_grad_steps += int(n)
+
+    def set_model_flops(self, flops: Optional[float], peak: Optional[float] = None, n_devices: int = 1) -> None:
+        with self._lock:
+            self.model_flops_per_step = flops
+            if peak is not None:
+                self.peak_flops = peak
+            self.n_devices = max(1, int(n_devices))
+
+    def mark(self, policy_step: int) -> Dict[str, float]:
+        """Close the interval ending at `policy_step`; returns sps /
+        grad_sps / replay_ratio / (mfu) and resets the interval."""
+        now = time.perf_counter()
+        with self._lock:
+            dt = max(now - self._last_time, 1e-9)
+            dsteps = int(policy_step) - self._last_step
+            grads = self._grad_steps
+            self._grad_steps = 0
+            self._last_step = int(policy_step)
+            self._last_time = now
+            flops, peak, ndev = self.model_flops_per_step, self.peak_flops, self.n_devices
+        out: Dict[str, float] = {
+            "sps": dsteps / dt,
+            "grad_steps_per_s": grads / dt,
+            "interval_steps": dsteps,
+            "interval_seconds": dt,
+        }
+        if dsteps > 0:
+            out["replay_ratio"] = grads * self.world_size / dsteps
+        if flops and peak:
+            out["mfu"] = mfu(flops, grads / dt, peak, ndev)
+        return out
+
+    @property
+    def total_grad_steps(self) -> int:
+        with self._lock:
+            return self._total_grad_steps

@@ -32,28 +32,78 @@ def get_log_dir(cfg: Config, root_dir: str, run_name: str, new_version: bool = T
     return str(log_dir)
 
 
+_tb_import_warned = False
+
+
 class TensorBoardLogger:
-    """Thin SummaryWriter wrapper; inert on non-zero processes or log_level=0."""
+    """Thin SummaryWriter wrapper; inert on non-zero processes or log_level=0.
+
+    When no SummaryWriter backend is importable the failure is no longer
+    silent: one warning is emitted per process, `.available` is False, and
+    metrics fall back to the telemetry JSONL sink (`metrics_fallback.jsonl`
+    in the log dir) instead of being dropped on the floor.
+    """
 
     def __init__(self, log_dir: str, enabled: bool = True):
         self.log_dir = log_dir
         self._writer = None
+        self._fallback = None
         self.enabled = enabled
         if enabled:
+            errors = []
             try:
                 from torch.utils.tensorboard import SummaryWriter
 
                 self._writer = SummaryWriter(log_dir=log_dir)
-            except Exception:
+            except Exception as err:
+                errors.append(err)
                 try:
                     from tensorboardX import SummaryWriter  # type: ignore
 
                     self._writer = SummaryWriter(log_dir=log_dir)
-                except Exception:
+                except Exception as err2:
+                    errors.append(err2)
                     self._writer = None
+            if self._writer is None and errors:
+                global _tb_import_warned
+                if not _tb_import_warned:
+                    _tb_import_warned = True
+                    import warnings
+
+                    warnings.warn(
+                        "No TensorBoard SummaryWriter backend available "
+                        f"({errors[-1]!r}); scalar metrics will be written to "
+                        "the telemetry JSONL fallback stream instead",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+
+    @property
+    def available(self) -> bool:
+        """True when a real SummaryWriter backend is attached."""
+        return self._writer is not None
+
+    def _fallback_sink(self):
+        if self._fallback is None:
+            from ..telemetry.sinks import JsonlSink
+
+            self._fallback = JsonlSink(str(Path(self.log_dir) / "metrics_fallback.jsonl"))
+        return self._fallback
 
     def log_metrics(self, metrics: Dict[str, Any], step: int) -> None:
+        if not self.enabled:
+            return
         if self._writer is None:
+            clean: Dict[str, float] = {}
+            for name, value in metrics.items():
+                try:
+                    clean[name] = float(value)
+                except (TypeError, ValueError):
+                    continue
+            if clean:
+                self._fallback_sink().write(
+                    {"event": "metrics", "step": int(step), "metrics": clean}
+                )
             return
         for name, value in metrics.items():
             try:
@@ -75,6 +125,9 @@ class TensorBoardLogger:
         if self._writer is not None:
             self._writer.flush()
             self._writer.close()
+        if self._fallback is not None:
+            self._fallback.close()
+            self._fallback = None
 
 
 class MLflowLogger:

@@ -1,36 +1,43 @@
-"""Wall-clock timers accumulated into a process-wide registry.
+"""Legacy wall-clock timer API — now a shim over `telemetry.spans`.
 
-Equivalent of the reference's `timer` ContextDecorator over torchmetrics
-SumMetric (sheeprl/utils/timer.py:16-85): ``with timer("Time/train_time"):``
-accumulates seconds; `timer.compute()` drains all timers. Class-level
-``disabled`` mirrors `metric.disable_timer`.
+``with timer("Time/train_time"):`` still accumulates seconds and
+`timer.compute()` still returns the registry, but the storage is the
+thread-safe process-wide `SpanTracker` shared with the `Telemetry` facade:
+
+* decoupled (player + trainer thread) runs no longer race on a bare class
+  dict, and
+* ``timer.compute(reset=True)`` drains atomically, so a log interval can
+  never double-count time that was already reported.
+
+Class-level ``disabled`` mirrors `metric.disable_timer`, as before. New code
+should use `Telemetry.span` (which adds device-trace annotations); this shim
+exists so out-of-tree imports of `sheeprl_tpu.utils.timer` keep working.
 """
 from __future__ import annotations
 
-import time
 from contextlib import ContextDecorator
-from typing import Dict, Optional
+from typing import Dict
+
+from ..telemetry.spans import GLOBAL_TRACKER, Span
 
 
 class timer(ContextDecorator):
     disabled: bool = False
-    _timers: Dict[str, float] = {}
 
     def __init__(self, name: str):
         self.name = name
-        self._start: Optional[float] = None
+        self._span: Span | None = None
 
     def __enter__(self) -> "timer":
         if not timer.disabled:
-            self._start = time.perf_counter()
+            self._span = Span(self.name, tracker=GLOBAL_TRACKER)
+            self._span.__enter__()
         return self
 
     def __exit__(self, *exc) -> bool:
-        if not timer.disabled and self._start is not None:
-            timer._timers[self.name] = timer._timers.get(self.name, 0.0) + (
-                time.perf_counter() - self._start
-            )
-        self._start = None
+        if self._span is not None:
+            self._span.__exit__(*exc)
+            self._span = None
         return False
 
     @classmethod
@@ -38,9 +45,10 @@ class timer(ContextDecorator):
         return None
 
     @classmethod
-    def compute(cls) -> Dict[str, float]:
-        return dict(cls._timers)
+    def compute(cls, reset: bool = False) -> Dict[str, float]:
+        """Snapshot name → seconds; ``reset=True`` drains atomically."""
+        return GLOBAL_TRACKER.compute(reset=reset)
 
     @classmethod
     def reset(cls) -> None:
-        cls._timers.clear()
+        GLOBAL_TRACKER.reset()
