@@ -350,15 +350,10 @@ def main(dist: Distributed, cfg: Config) -> None:
 
 @register_evaluation(algorithms=["ppo", "ppo_decoupled"])
 def evaluate_ppo(dist: Distributed, cfg: Config, state: Dict[str, Any]) -> None:
-    """Reference ppo/evaluate.py:15 and :58: rebuild env+agent from a
-    checkpoint, test. The decoupled trainer saves the same {params} pytree,
-    so one eval covers both entry points."""
-    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
-    logger = get_logger(cfg, log_dir, dist.process_index)
-    env = vectorize(cfg, cfg.seed, 0, log_dir).envs[0]
-    root_key = dist.seed_everything(cfg.seed)
-    obs_space = env.observation_space
-    module, params = build_agent(
-        dist, cfg, obs_space, env.action_space, root_key, state["params"]
-    )
-    test(module, params, env, cfg, log_dir, logger)
+    """Reference ppo/evaluate.py:15 and :58. Routed through the serving
+    subsystem's `InferencePolicy` (serve/evaluate.py), so evaluation and
+    `sheeprl_tpu serve` share one checkpoint→policy path; the decoupled
+    trainer saves the same {params} pytree, so one eval covers both."""
+    from ...serve.evaluate import evaluate_with_policy
+
+    evaluate_with_policy(dist, cfg, state)

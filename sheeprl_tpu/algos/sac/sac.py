@@ -325,13 +325,11 @@ def main(dist: Distributed, cfg: Config) -> None:
 
 @register_evaluation(algorithms=["sac", "sac_decoupled"])
 def evaluate_sac(dist: Distributed, cfg: Config, state: Dict[str, Any]) -> None:
-    """Reference sac/evaluate.py:15 (registered for sac AND sac_decoupled):
-    the decoupled trainer checkpoints the same {params} pytree."""
-    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
-    logger = get_logger(cfg, log_dir, dist.process_index)
-    env = vectorize(cfg, cfg.seed, 0, log_dir).envs[0]
-    root_key = dist.seed_everything(cfg.seed)
-    actor, critic, params = build_agent(
-        dist, cfg, env.observation_space, env.action_space, root_key, state["params"]
-    )
-    test(actor, params["actor"], env, cfg, log_dir, logger)
+    """Reference sac/evaluate.py:15 (registered for sac AND sac_decoupled).
+    Routed through the serving subsystem's `InferencePolicy`
+    (serve/evaluate.py) — evaluation and serving share one
+    checkpoint→policy path; the decoupled trainer checkpoints the same
+    {params} pytree."""
+    from ...serve.evaluate import evaluate_with_policy
+
+    evaluate_with_policy(dist, cfg, state)

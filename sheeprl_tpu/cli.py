@@ -174,13 +174,8 @@ def run(args: Optional[Sequence[str]] = None) -> None:
     run_algorithm(cfg)
 
 
-def evaluation(args: Optional[Sequence[str]] = None) -> None:
-    """`sheeprl_tpu eval checkpoint_path=... [key=value ...]`
-    (reference cli.py:369-405): rebuild the run config from the checkpoint's
-    saved config.yaml, then launch the registered evaluation fn."""
-    argv = list(args if args is not None else sys.argv[1:])
-    import sheeprl_tpu  # ensure registries are populated
-
+def _split_checkpoint_arg(argv: Sequence[str], command: str) -> tuple:
+    """Pull `checkpoint_path=...` out of an argv, validating it exists."""
     ckpt: Optional[str] = None
     rest: List[str] = []
     for a in argv:
@@ -189,24 +184,69 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
         else:
             rest.append(a)
     if ckpt is None:
-        raise ValueError("evaluation requires `checkpoint_path=<path to .ckpt>`")
+        raise ValueError(f"{command} requires `checkpoint_path=<path to .ckpt>`")
     ckpt_path = pathlib.Path(ckpt)
     if not ckpt_path.is_file():
         raise FileNotFoundError(f"Checkpoint not found: {ckpt_path}")
+    return ckpt_path, rest
+
+
+def _load_config_beside(ckpt_path: pathlib.Path) -> Config:
     cfg_path = ckpt_path.parent.parent / "config.yaml"
     if not cfg_path.is_file():
         raise FileNotFoundError(f"Missing saved config beside checkpoint: {cfg_path}")
-    cfg = load_config_file(cfg_path)
-    for ov in rest:
-        if "=" in ov:
-            k, _, v = ov.partition("=")
-            import yaml
+    return load_config_file(cfg_path)
 
-            cfg.set_path(k, yaml.safe_load(v))
+
+def _apply_cli_overrides(cfg: Config, overrides: Sequence[str]) -> None:
+    """Apply `a.b.c=value` overrides to a loaded config. A malformed
+    override (no '=') is an error, not a silent no-op."""
+    import yaml
+
+    for ov in overrides:
+        if "=" not in ov:
+            raise ValueError(f"Malformed override '{ov}' (expected key=value)")
+        k, _, v = ov.partition("=")
+        cfg.set_path(k.strip(), yaml.safe_load(v))
+
+
+def evaluation(args: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl_tpu eval checkpoint_path=... [key=value ...]`
+    (reference cli.py:369-405): rebuild the run config from the checkpoint's
+    saved config.yaml, then launch the registered evaluation fn."""
+    argv = list(args if args is not None else sys.argv[1:])
+    import sheeprl_tpu  # ensure registries are populated
+
+    ckpt_path, rest = _split_checkpoint_arg(argv, "evaluation")
+    cfg = _load_config_beside(ckpt_path)
+    _apply_cli_overrides(cfg, rest)
     cfg["checkpoint_path"] = str(ckpt_path)
     # reference cli.py:371-401: disable loggers/ckpt writes during eval
     cfg.set_path("metric.log_level", cfg.select("metric.log_level", 1))
     eval_algorithm(cfg)
+
+
+def serve(args: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl_tpu serve checkpoint_path=... [serve.http.port=... ...]` —
+    serve a trained checkpoint behind the micro-batching inference engine
+    (serve/server.py): bucketed jitted apply, deadline-coalesced batches,
+    checkpoint hot-reload and a stdlib-HTTP JSON endpoint."""
+    argv = list(args if args is not None else sys.argv[1:])
+    import sheeprl_tpu  # ensure registries are populated
+    from .config.compose import CONFIG_ROOT
+    from .utils.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    ckpt_path, rest = _split_checkpoint_arg(argv, "serve")
+    cfg = _load_config_beside(ckpt_path)
+    # saved run configs predate the serve group: compose its defaults in
+    if cfg.select("serve") is None:
+        cfg["serve"] = load_config_file(CONFIG_ROOT / "serve" / "default.yaml")
+    _apply_cli_overrides(cfg, rest)
+    cfg["checkpoint_path"] = str(ckpt_path)
+    from .serve.server import serve_from_checkpoint
+
+    serve_from_checkpoint(ckpt_path, cfg)
 
 
 def registration(args: Optional[Sequence[str]] = None) -> None:
@@ -263,9 +303,9 @@ def available_agents() -> None:
 
 
 def main() -> None:
-    """Console dispatcher: `python -m sheeprl_tpu <run|eval|registration|agents> ...`"""
+    """Console dispatcher: `python -m sheeprl_tpu <run|eval|serve|registration|agents> ...`"""
     argv = sys.argv[1:]
-    if argv and argv[0] in ("run", "eval", "evaluation", "registration", "agents"):
+    if argv and argv[0] in ("run", "eval", "evaluation", "serve", "registration", "agents"):
         cmd, rest = argv[0], argv[1:]
     else:
         cmd, rest = "run", argv
@@ -273,6 +313,8 @@ def main() -> None:
         run(rest)
     elif cmd in ("eval", "evaluation"):
         evaluation(rest)
+    elif cmd == "serve":
+        serve(rest)
     elif cmd == "registration":
         registration(rest)
     elif cmd == "agents":

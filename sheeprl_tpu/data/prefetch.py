@@ -95,7 +95,7 @@ class DevicePrefetcher:
                         break
                     except queue.Full:
                         continue
-        except BaseException as e:  # surfaced on next __next__
+        except BaseException as e:  # surfaced on next __next__ / get
             self._exc = e
 
     def start(self) -> "DevicePrefetcher":
@@ -108,29 +108,55 @@ class DevicePrefetcher:
         self.start()
         return self
 
+    def _raise_worker_exc(self) -> None:
+        # _exc stays set: every subsequent consumer call fails loudly too,
+        # instead of one caller seeing the error and the next a silent
+        # StopIteration (a dead prefetcher must never look exhausted)
+        if self._exc is not None:
+            raise self._exc
+
     def __next__(self) -> Any:
-        if self._thread is None:
+        if self._thread is None and not self._stop.is_set():
             self.start()
         while True:
-            if self._exc is not None:
-                exc, self._exc = self._exc, None
-                raise exc
+            self._raise_worker_exc()
             try:
-                return self._q.get(timeout=1.0)
+                return self._q.get(timeout=0.1)
             except queue.Empty:
-                if self._thread is not None and not self._thread.is_alive() and self._exc is None:
+                # `_stop` covers a concurrent stop() (which nulls _thread
+                # before the join finishes) as well as a worker that died
+                thread = self._thread
+                if self._stop.is_set() or (thread is not None and not thread.is_alive()):
+                    self._raise_worker_exc()
                     raise StopIteration
 
     def get(self) -> Any:
-        """Synchronous one-shot fetch (no background thread)."""
+        """Synchronous one-shot fetch (no background thread) — but if a
+        background worker already died with an error, surface that instead
+        of silently sampling around it."""
+        self._raise_worker_exc()
         return self._put_device(self.sample_fn())
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop the worker and join it. The queue is drained *while* the
+        worker winds down so a producer blocked in `put` on a full queue is
+        released immediately rather than timing the join out. A worker stuck
+        inside `sample_fn` itself cannot be interrupted — after `timeout`
+        seconds it is abandoned (it is a daemon thread) instead of hanging
+        the caller."""
+        import time
+
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
-            self._thread = None
-        while not self._q.empty():
+        thread, self._thread = self._thread, None
+        deadline = time.monotonic() + timeout
+        while thread is not None and thread.is_alive() and time.monotonic() < deadline:
+            while True:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+            thread.join(timeout=0.05)
+        while True:
             try:
                 self._q.get_nowait()
             except queue.Empty:

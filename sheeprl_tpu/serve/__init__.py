@@ -1,0 +1,27 @@
+"""Batched policy serving: checkpoint → micro-batched inference (+hot reload).
+
+    from sheeprl_tpu.serve import serve_from_checkpoint
+    server = serve_from_checkpoint("…/ckpt_1024.ckpt", cfg, block=False)
+    actions = server.act({"state": obs_vec})
+
+See ``howto/serving.md`` for bucketing, backpressure and hot-reload
+semantics.
+"""
+from .batcher import Backpressure, MicroBatcher, ServeStats
+from .policy import InferencePolicy, PolicyCore, SessionStore, env_action, register_policy_builder
+from .reload import CheckpointReloader
+from .server import PolicyServer, serve_from_checkpoint
+
+__all__ = [
+    "Backpressure",
+    "CheckpointReloader",
+    "InferencePolicy",
+    "MicroBatcher",
+    "PolicyCore",
+    "PolicyServer",
+    "ServeStats",
+    "SessionStore",
+    "env_action",
+    "register_policy_builder",
+    "serve_from_checkpoint",
+]

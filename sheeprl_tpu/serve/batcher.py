@@ -1,0 +1,308 @@
+"""Micro-batching request queue with deadline flush and backpressure.
+
+Concurrent single-observation requests are coalesced into one policy batch:
+the flush thread waits until either the largest compiled bucket is full or
+``max_wait_ms`` has passed since the oldest pending request, then takes the
+longest same-``deterministic`` run from the head of the queue (FIFO — a flag
+flip ends the batch rather than reordering requests), pads it to the bucket
+shape and steps the policy once. Results are scattered back to the waiting
+callers.
+
+Saturation is explicit: when ``max_pending`` requests are already queued,
+``submit`` fails fast with :class:`Backpressure` carrying a ``retry_after_s``
+estimate (queue depth × recent per-batch latency / batch width) instead of
+letting latency grow without bound — the HTTP layer maps it to
+``503 Retry-After``.
+
+`ServeStats` tracks queue depth, batch occupancy, latency percentiles and
+reject/error counts; `MicroBatcher` periodically emits them as ``serve``
+events on the shared telemetry JSONL stream.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+from .policy import InferencePolicy
+
+
+class Backpressure(RuntimeError):
+    """The request queue is full; retry after ``retry_after_s`` seconds."""
+
+    def __init__(self, retry_after_s: float, depth: int) -> None:
+        super().__init__(
+            f"serving queue saturated ({depth} pending); retry after {retry_after_s:.2f}s"
+        )
+        self.retry_after_s = float(retry_after_s)
+        self.depth = int(depth)
+
+
+class _Request:
+    __slots__ = ("obs", "deterministic", "session", "event", "result", "error", "t_submit")
+
+    def __init__(self, obs: Any, deterministic: bool, session: Optional[str]) -> None:
+        self.obs = obs
+        self.deterministic = bool(deterministic)
+        self.session = session
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self.t_submit = time.monotonic()
+
+
+class ServeStats:
+    """Thread-safe serving counters + latency reservoir."""
+
+    def __init__(self, reservoir: int = 4096) -> None:
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.completed = 0
+        self.rejected = 0
+        self.errors = 0
+        self.batches = 0
+        self.batched_items = 0
+        self._occupancy_sum = 0.0
+        self._batch_seconds_sum = 0.0
+        self._latencies: Deque[float] = deque(maxlen=reservoir)
+
+    def record_submit(self) -> None:
+        with self._lock:
+            self.requests += 1
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_batch(self, n: int, bucket: int, seconds: float) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batched_items += n
+            self._occupancy_sum += n / max(1, bucket)
+            self._batch_seconds_sum += seconds
+
+    def record_done(self, latency_s: float, error: bool = False) -> None:
+        with self._lock:
+            if error:
+                self.errors += 1
+            else:
+                self.completed += 1
+            self._latencies.append(latency_s * 1000.0)
+
+    def _percentile(self, sorted_ms: List[float], p: float) -> float:
+        if not sorted_ms:
+            return 0.0
+        idx = min(len(sorted_ms) - 1, int(round(p * (len(sorted_ms) - 1))))
+        return sorted_ms[idx]
+
+    def avg_batch_seconds(self) -> float:
+        with self._lock:
+            return self._batch_seconds_sum / self.batches if self.batches else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            lat = sorted(self._latencies)
+            return {
+                "requests": self.requests,
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "errors": self.errors,
+                "batches": self.batches,
+                "batch_occupancy": round(self._occupancy_sum / self.batches, 4)
+                if self.batches
+                else 0.0,
+                "avg_batch_size": round(self.batched_items / self.batches, 4)
+                if self.batches
+                else 0.0,
+                "p50_ms": round(self._percentile(lat, 0.50), 3),
+                "p99_ms": round(self._percentile(lat, 0.99), 3),
+            }
+
+
+class MicroBatcher:
+    """Coalesces concurrent `submit` calls into bucket-shaped policy batches."""
+
+    def __init__(
+        self,
+        policy: InferencePolicy,
+        max_wait_ms: float = 5.0,
+        max_pending: int = 256,
+        request_timeout_s: float = 30.0,
+        sink: Any = None,
+        log_every_s: float = 10.0,
+    ) -> None:
+        self.policy = policy
+        self.max_wait_s = max(0.0, float(max_wait_ms) / 1000.0)
+        self.max_pending = int(max_pending)
+        self.request_timeout_s = float(request_timeout_s)
+        self.stats = ServeStats()
+        self._sink = sink
+        self._log_every_s = float(log_every_s)
+        self._last_log = time.monotonic()
+        self._pending: Deque[_Request] = deque()
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._flush_loop, daemon=True, name="microbatcher")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        # fail whatever is still queued so no caller hangs on shutdown
+        with self._cv:
+            leftovers = list(self._pending)
+            self._pending.clear()
+        for req in leftovers:
+            req.error = RuntimeError("serving shut down")
+            req.event.set()
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._pending)
+
+    # -- client API --------------------------------------------------------
+    def submit(
+        self,
+        raw_obs: Dict[str, Any],
+        deterministic: bool = False,
+        session: Optional[str] = None,
+        timeout_s: Optional[float] = None,
+    ) -> Any:
+        """Enqueue one observation; block until its action row is ready.
+
+        Raises :class:`Backpressure` when the queue is saturated and
+        ``TimeoutError`` when the request is not served within the timeout.
+        """
+        self.start()
+        prepared = self.policy.prepare(raw_obs, 1)
+        # reject malformed obs here, where only THIS caller pays: inside a
+        # coalesced batch it would fail every rider (or retrace a new shape)
+        validate = getattr(self.policy, "validate_prepared", None)
+        if validate is not None:
+            validate(prepared, 1)
+        req = _Request(prepared, deterministic, session)
+        with self._cv:
+            if len(self._pending) >= self.max_pending:
+                self.stats.record_reject()
+                retry = self._retry_after_locked()
+                raise Backpressure(retry, len(self._pending))
+            self._pending.append(req)
+            self.stats.record_submit()
+            self._cv.notify_all()
+        timeout = timeout_s if timeout_s is not None else self.request_timeout_s
+        if not req.event.wait(timeout):
+            # abandoned requests must not keep consuming device batches or
+            # inflating the backpressure estimate
+            with self._cv:
+                try:
+                    self._pending.remove(req)
+                except ValueError:
+                    pass  # already taken into a running batch
+            raise TimeoutError(f"policy request not served within {timeout}s")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def _retry_after_locked(self) -> float:
+        per_batch = self.stats.avg_batch_seconds() or self.max_wait_s or 0.05
+        width = self.policy.buckets[-1]
+        return max(0.05, len(self._pending) / max(1, width) * per_batch)
+
+    # -- the flush loop ----------------------------------------------------
+    def _take_batch_locked(self) -> List[_Request]:
+        """Longest same-deterministic run from the queue head, ≤ max bucket."""
+        max_n = self.policy.buckets[-1]
+        batch: List[_Request] = []
+        while self._pending and len(batch) < max_n:
+            if batch and self._pending[0].deterministic != batch[0].deterministic:
+                break
+            batch.append(self._pending.popleft())
+        return batch
+
+    def _flush_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._cv:
+                while not self._pending and not self._stop.is_set():
+                    self._cv.wait(timeout=0.1)
+                if self._stop.is_set():
+                    return
+                # deadline flush: give the batch max_wait_s from the OLDEST
+                # request to fill the widest bucket, then go with what's there
+                deadline = self._pending[0].t_submit + self.max_wait_s
+                while (
+                    len(self._pending) < self.policy.buckets[-1]
+                    and not self._stop.is_set()
+                    and time.monotonic() < deadline
+                ):
+                    self._cv.wait(timeout=max(0.0, deadline - time.monotonic()))
+                batch = self._take_batch_locked()
+            if batch:
+                self._run_batch(batch)
+            self._maybe_emit()
+
+    def _run_batch(self, batch: List[_Request]) -> None:
+        import jax
+        import numpy as np
+
+        n = len(batch)
+        t0 = time.monotonic()
+        try:
+            obs = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *[r.obs for r in batch])
+            actions = self.policy.act_batch(
+                obs, n, deterministic=batch[0].deterministic, sessions=[r.session for r in batch]
+            )
+        except BaseException as e:  # a bad request must not kill the server
+            now = time.monotonic()
+            for req in batch:
+                req.error = e
+                self.stats.record_done(now - req.t_submit, error=True)
+                req.event.set()
+            return
+        dt = time.monotonic() - t0
+        from .policy import _bucket_for
+
+        self.stats.record_batch(n, _bucket_for(n, self.policy.buckets), dt)
+        now = time.monotonic()
+        for i, req in enumerate(batch):
+            req.result = actions[i : i + 1]
+            self.stats.record_done(now - req.t_submit)
+            req.event.set()
+
+    # -- telemetry ---------------------------------------------------------
+    def serve_record(self) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "event": "serve",
+            "t": round(time.time(), 3),
+            "queue_depth": self.queue_depth,
+            "retraces": self.policy.retraces_since_warmup(),
+            "reloads": self.policy.reload_count,
+            "params_version": self.policy.params_version,
+            "sessions": len(self.policy.sessions),
+        }
+        rec.update(self.stats.snapshot())
+        return rec
+
+    def _maybe_emit(self) -> None:
+        if self._sink is None or self._log_every_s <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_log < self._log_every_s:
+            return
+        self._last_log = now
+        try:
+            self._sink.write(self.serve_record())
+        except Exception:
+            pass

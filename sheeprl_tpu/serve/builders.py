@@ -1,0 +1,192 @@
+"""Per-algorithm policy builders for the serving subsystem.
+
+Each builder maps ``(cfg, observation_space, action_space)`` to a
+:class:`~sheeprl_tpu.serve.policy.PolicyCore` — the pure apply/prepare
+functions plus the checkpoint-params extraction for that algorithm. Builders
+reuse the algos' own module constructors (``build_agent`` with an identity
+``dist`` and empty params, so no throwaway init happens) and their
+``prepare_obs`` shaping, with one serving-specific addition: observation
+dtypes are canonicalized to the env's observation-space dtypes so a JSON
+client sending ints can never trigger a retrace of the warmed buckets.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from .policy import PolicyCore, register_policy_builder
+
+
+class _HostDist:
+    """Identity stand-in for `Distributed`: inference params live on the
+    player device (see `parallel.placement`), not a training mesh."""
+
+    @staticmethod
+    def replicate(tree: Any) -> Any:
+        return tree
+
+
+def _actions_dim(action_space: Any) -> Tuple[List[int], bool]:
+    import gymnasium as gym
+
+    if isinstance(action_space, gym.spaces.Box):
+        return [int(np.prod(action_space.shape))], True
+    if isinstance(action_space, gym.spaces.MultiDiscrete):
+        return [int(n) for n in action_space.nvec], False
+    return [int(action_space.n)], False
+
+
+@register_policy_builder("ppo", "ppo_decoupled", "a2c")
+def build_ppo_policy(cfg: Any, observation_space: Any, action_space: Any) -> PolicyCore:
+    import jax
+
+    from ..algos.ppo.agent import actions_and_log_probs, build_agent
+    from ..algos.ppo.utils import prepare_obs
+
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    module, _ = build_agent(
+        _HostDist(), cfg, observation_space, action_space, jax.random.key(0), params={}
+    )
+
+    def apply(params, obs, state, key, greedy):
+        actor_out, _ = module.apply({"params": params}, obs)
+        key, sub = jax.random.split(key)
+        actions, _, _ = actions_and_log_probs(
+            actor_out, module.is_continuous, key=sub, greedy=greedy
+        )
+        return actions, state, key
+
+    def prepare(raw: Dict[str, Any], n: int) -> Dict[str, np.ndarray]:
+        out = prepare_obs(raw, cnn_keys, mlp_keys, n)
+        for k in cnn_keys:
+            out[k] = out[k].astype(observation_space[k].dtype, copy=False)
+        return out
+
+    def dummy_obs(n: int) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for k in cnn_keys:
+            shape = tuple(observation_space[k].shape)[-3:]
+            out[k] = np.zeros((n, *shape), observation_space[k].dtype)
+        for k in mlp_keys:
+            out[k] = np.zeros((n, int(np.prod(observation_space[k].shape))), np.float32)
+        return out
+
+    return PolicyCore(
+        apply=apply,
+        extract_params=lambda p: p,
+        prepare=prepare,
+        dummy_obs=dummy_obs,
+        name=str(cfg.select("algo.name", "ppo")),
+    )
+
+
+@register_policy_builder("sac", "sac_decoupled", "droq")
+def build_sac_policy(cfg: Any, observation_space: Any, action_space: Any) -> PolicyCore:
+    import gymnasium as gym
+    import jax
+
+    from ..algos.sac.agent import SACActor, sample_actions
+    from ..algos.sac.utils import prepare_obs
+
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError(f"SAC-family policies need continuous (Box) actions, got {action_space}")
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_dim = int(sum(np.prod(observation_space[k].shape) for k in mlp_keys))
+    actor = SACActor(
+        action_dim=int(np.prod(action_space.shape)),
+        hidden_size=cfg.algo.actor.hidden_size,
+        action_low=action_space.low.tolist(),
+        action_high=action_space.high.tolist(),
+    )
+
+    def apply(params, obs, state, key, greedy):
+        mean, log_std = actor.apply({"params": params}, obs)
+        key, sub = jax.random.split(key)
+        actions, _ = sample_actions(actor, mean, log_std, sub, greedy=greedy)
+        return actions, state, key
+
+    def prepare(raw: Dict[str, Any], n: int) -> np.ndarray:
+        return prepare_obs(raw, mlp_keys, n)
+
+    def dummy_obs(n: int) -> np.ndarray:
+        return np.zeros((n, obs_dim), np.float32)
+
+    return PolicyCore(
+        apply=apply,
+        extract_params=lambda p: p["actor"],
+        prepare=prepare,
+        dummy_obs=dummy_obs,
+        name=str(cfg.select("algo.name", "sac")),
+    )
+
+
+@register_policy_builder("dreamer_v3")
+def build_dreamer_v3_policy(cfg: Any, observation_space: Any, action_space: Any) -> PolicyCore:
+    import jax
+    import jax.numpy as jnp
+
+    from ..algos.dreamer_v3.agent import WorldModel, build_agent, sample_actor_actions
+    from ..algos.dreamer_v3.utils import normalize_obs, prepare_obs
+
+    actions_dim, is_continuous = _actions_dim(action_space)
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    wm, actor, _, _ = build_agent(
+        _HostDist(), cfg, observation_space, actions_dim, is_continuous, jax.random.key(0), state={}
+    )
+
+    def apply(params, obs, state, key, greedy):
+        # one recurrent player step, batch-shape agnostic (cf. the train-time
+        # player in dreamer_v3.make_player, which fixes num_envs at build)
+        h, z, a = state
+        obs = normalize_obs(obs, cnn_keys)
+        embedded = wm.apply({"params": params["wm"]}, obs, method=WorldModel.embed)
+        h = wm.apply(
+            {"params": params["wm"]},
+            jnp.concatenate([z, a], -1),
+            h,
+            method=WorldModel.recurrent_step,
+        )
+        key, k1, k2 = jax.random.split(key, 3)
+        z = wm.apply(
+            {"params": params["wm"]}, h, embedded, k1, method=WorldModel.representation_step
+        )
+        pre = actor.apply({"params": params["actor"]}, jnp.concatenate([z, h], -1))
+        acts, _ = sample_actor_actions(actor, pre, k2, greedy=greedy)
+        a = jnp.concatenate(acts, -1)
+        if is_continuous:
+            env_actions = a
+        else:
+            env_actions = jnp.stack([jnp.argmax(x, axis=-1) for x in acts], axis=-1)
+        return env_actions, (h, z, a), key
+
+    def init_state(params, n: int):
+        h0, z0 = wm.apply({"params": params["wm"]}, (n,), method=WorldModel.initial_states)
+        a0 = jnp.zeros((n, int(sum(actions_dim))))
+        return (h0, z0, a0)
+
+    def prepare(raw: Dict[str, Any], n: int) -> Dict[str, np.ndarray]:
+        out = prepare_obs(raw, cnn_keys, mlp_keys, n)
+        for k in cnn_keys:
+            out[k] = out[k].astype(observation_space[k].dtype, copy=False)
+        return out
+
+    def dummy_obs(n: int) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for k in cnn_keys:
+            shape = tuple(observation_space[k].shape)[-3:]
+            out[k] = np.zeros((n, *shape), observation_space[k].dtype)
+        for k in mlp_keys:
+            out[k] = np.zeros((n, int(np.prod(observation_space[k].shape))), np.float32)
+        return out
+
+    return PolicyCore(
+        apply=apply,
+        extract_params=lambda p: {"wm": p["wm"], "actor": p["actor"]},
+        prepare=prepare,
+        dummy_obs=dummy_obs,
+        init_state=init_state,
+        name="dreamer_v3",
+    )

@@ -1,0 +1,57 @@
+"""Evaluation on top of `InferencePolicy`.
+
+The registered per-algo ``evaluate_*`` functions used to rebuild the agent
+themselves; PPO- and SAC-family evaluation now routes through the same
+checkpoint→policy path the server uses, so a policy that evaluates is a
+policy that serves (and vice versa — one adapter to keep correct).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .policy import InferencePolicy, env_action
+
+
+def run_policy_episode(
+    policy: InferencePolicy,
+    env: Any,
+    cfg: Any,
+    logger: Any = None,
+    deterministic: bool = True,
+    session: Optional[str] = "eval",
+) -> float:
+    """One greedy episode through the single-request act path (the same
+    prepare→bucket→apply pipeline serving traffic takes)."""
+    done = False
+    cumulative_rew = 0.0
+    obs, _ = env.reset(seed=cfg.seed)
+    while not done:
+        actions = policy.act(obs, deterministic=deterministic, session=session)
+        obs, reward, terminated, truncated, _ = env.step(env_action(actions[0], env.action_space))
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.get("dry_run", False):
+            done = True
+    if session is not None:
+        policy.sessions.drop(session)
+    if logger is not None:
+        logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    print(f"Test - Reward: {cumulative_rew}")
+    env.close()
+    return cumulative_rew
+
+
+def evaluate_with_policy(dist: Any, cfg: Any, state: Dict[str, Any]) -> float:
+    """Shared body for registered evaluations: checkpoint state → policy →
+    greedy episode (replaces the per-algo rebuild-the-agent duplicates)."""
+    from ..utils.env import vectorize
+    from ..utils.logger import get_log_dir, get_logger
+
+    log_dir = get_log_dir(cfg, cfg.root_dir, cfg.run_name)
+    logger = get_logger(cfg, log_dir, dist.process_index)
+    env = vectorize(cfg, cfg.seed, 0, log_dir).envs[0]
+    dist.seed_everything(cfg.seed)
+    policy = InferencePolicy.from_state(
+        cfg, state["params"], env.observation_space, env.action_space
+    )
+    return run_policy_episode(policy, env, cfg, logger)

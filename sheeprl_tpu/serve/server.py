@@ -1,0 +1,252 @@
+"""The policy server: in-process client + stdlib HTTP JSON endpoint.
+
+``PolicyServer`` wires the serving stack together — `InferencePolicy`
+(bucketed jitted apply), `MicroBatcher` (deadline-coalesced batches with
+backpressure) and `CheckpointReloader` (hot weight swaps) — and exposes it
+two ways:
+
+* **in-process**: ``server.act(obs, deterministic, session)`` for evaluation
+  loops, notebooks and tests (no sockets involved);
+* **HTTP**: a ``ThreadingHTTPServer`` speaking JSON. Each connection thread
+  blocks in ``MicroBatcher.submit``, which is exactly what lets concurrent
+  HTTP traffic coalesce into device batches.
+
+Endpoints:
+
+    POST /v1/act      {"obs": {...}, "deterministic": bool, "session_id": str}
+                      -> {"actions": [[...]], "params_version": int}
+    GET  /healthz     liveness + params version
+    GET  /stats       full serve telemetry snapshot (the `serve` JSONL record)
+    503 + Retry-After when the queue is saturated (Backpressure)
+
+`serve_from_checkpoint` is the CLI entrypoint's workhorse: checkpoint →
+policy (+warmup) → batcher → reloader → HTTP, with serve telemetry JSONL
+written next to the run (``<run_dir>/serve/telemetry.jsonl``).
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .batcher import Backpressure, MicroBatcher
+from .policy import InferencePolicy
+from .reload import CheckpointReloader
+
+
+class PolicyServer:
+    """Owns the serving stack; start()/stop() manage all background threads."""
+
+    def __init__(
+        self,
+        policy: InferencePolicy,
+        batcher: MicroBatcher,
+        reloader: Optional[CheckpointReloader] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        http_enabled: bool = True,
+    ) -> None:
+        self.policy = policy
+        self.batcher = batcher
+        self.reloader = reloader
+        self.host = host
+        self._requested_port = int(port)
+        self.http_enabled = bool(http_enabled)
+        self._httpd: Any = None
+        self._http_thread: Optional[threading.Thread] = None
+
+    # -- in-process client -------------------------------------------------
+    def act(
+        self,
+        obs: Dict[str, Any],
+        deterministic: bool = False,
+        session: Optional[str] = None,
+        timeout_s: Optional[float] = None,
+    ) -> np.ndarray:
+        """Blocking single-observation request through the micro-batcher."""
+        return self.batcher.submit(obs, deterministic=deterministic, session=session, timeout_s=timeout_s)
+
+    def stats(self) -> Dict[str, Any]:
+        return self.batcher.serve_record()
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd is not None else None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "PolicyServer":
+        self.batcher.start()
+        if self.reloader is not None:
+            self.reloader.start()
+        if self.http_enabled and self._httpd is None:
+            from http.server import ThreadingHTTPServer
+
+            handler = _make_handler(self)
+            self._httpd = ThreadingHTTPServer((self.host, self._requested_port), handler)
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True, name="policy-http"
+            )
+            self._http_thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        """Block the calling thread until interrupted (CLI mode)."""
+        self.start()
+        try:
+            while True:
+                threading.Event().wait(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._http_thread = None
+        if self.reloader is not None:
+            self.reloader.stop()
+        self.batcher.stop()
+
+
+def _make_handler(server: "PolicyServer"):
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt: str, *args: Any) -> None:  # quiet by default
+            pass
+
+        def _reply(self, code: int, payload: Dict[str, Any], headers: Optional[Dict[str, str]] = None) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:
+            if self.path == "/healthz":
+                self._reply(
+                    200,
+                    {
+                        "status": "ok",
+                        "params_version": server.policy.params_version,
+                        "reloads": server.policy.reload_count,
+                    },
+                )
+            elif self.path == "/stats":
+                self._reply(200, server.stats())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self) -> None:
+            if self.path not in ("/v1/act", "/act"):
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("body must be a JSON object")
+                raw_obs = payload.get("obs")
+                if not isinstance(raw_obs, dict) or not raw_obs:
+                    raise ValueError("body must carry a non-empty 'obs' object")
+                obs = {k: np.asarray(v) for k, v in raw_obs.items()}
+                deterministic = bool(payload.get("deterministic", False))
+                session = payload.get("session_id")
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": str(e)})
+                return
+            try:
+                actions = server.act(obs, deterministic=deterministic, session=session)
+            except ValueError as e:  # malformed obs (shape/dtype/structure)
+                self._reply(400, {"error": str(e)})
+                return
+            except Backpressure as e:
+                self._reply(
+                    503,
+                    {"error": str(e), "retry_after_s": e.retry_after_s},
+                    headers={"Retry-After": f"{max(1, int(round(e.retry_after_s)))}"},
+                )
+                return
+            except TimeoutError as e:
+                self._reply(504, {"error": str(e)})
+                return
+            except Exception as e:
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._reply(
+                200,
+                {
+                    "actions": np.asarray(actions).tolist(),
+                    "params_version": server.policy.params_version,
+                },
+            )
+
+    return Handler
+
+
+def serve_from_checkpoint(ckpt_path: Any, cfg: Any, block: bool = True) -> PolicyServer:
+    """Checkpoint → warmed policy → batcher (+hot reload, +HTTP): the
+    ``sheeprl_tpu serve`` entrypoint. With ``block=False`` (tests, embedding)
+    the started server is returned instead of blocking."""
+    from ..telemetry.sinks import JsonlSink
+
+    ckpt_path = pathlib.Path(ckpt_path)
+    sel = cfg.select
+    policy = InferencePolicy.from_checkpoint(ckpt_path, cfg=cfg)
+    if bool(sel("serve.warmup.enabled", True)):
+        variants = sel("serve.warmup.greedy_variants", [True, False])
+        policy.warmup(tuple(bool(v) for v in variants))
+
+    sink = None
+    if bool(sel("serve.telemetry.jsonl", True)):
+        run_dir = ckpt_path.parent.parent
+        sink = JsonlSink(str(run_dir / "serve" / "telemetry.jsonl"))
+    batcher = MicroBatcher(
+        policy,
+        max_wait_ms=float(sel("serve.max_wait_ms", 5.0)),
+        max_pending=int(sel("serve.max_pending", 256)),
+        request_timeout_s=float(sel("serve.request_timeout_s", 30.0)),
+        sink=sink,
+        log_every_s=float(sel("serve.telemetry.log_every_s", 10.0)),
+    )
+    reloader = None
+    if bool(sel("serve.hot_reload.enabled", True)):
+        try:
+            loaded_step = int(ckpt_path.stem.split("_")[1])
+        except (IndexError, ValueError):
+            loaded_step = -1
+        reloader = CheckpointReloader(
+            policy,
+            ckpt_path.parent,
+            poll_interval_s=float(sel("serve.hot_reload.poll_interval_s", 2.0)),
+            loaded_step=loaded_step,
+            sink=sink,
+        )
+    server = PolicyServer(
+        policy,
+        batcher,
+        reloader=reloader,
+        host=str(sel("serve.http.host", "127.0.0.1")),
+        port=int(sel("serve.http.port", 8190)),
+        http_enabled=bool(sel("serve.http.enabled", True)),
+    )
+    if sink is not None:
+        sink.write(batcher.serve_record())  # startup snapshot (warmup state)
+    if block:
+        if server.http_enabled:
+            server.start()
+            print(f"[serve] policy '{policy.core.name}' listening on http://{server.host}:{server.port}")
+        server.serve_forever()
+        return server
+    return server.start()

@@ -78,6 +78,32 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "action": (True, _STR),  # started | stopped
         "trace_dir": (False, _STR),
     },
+    # policy-serving stat snapshot (serve/batcher.py): queue depth, batch
+    # occupancy, latency percentiles, retrace/reload counters
+    "serve": {
+        "requests": (True, _NUM),
+        "completed": (False, _NUM),
+        "rejected": (False, _NUM),
+        "errors": (False, _NUM),
+        "batches": (False, _NUM),
+        "queue_depth": (False, _NUM),
+        "batch_occupancy": (False, _NUM),
+        "avg_batch_size": (False, _NUM),
+        "p50_ms": (False, _NUM),
+        "p99_ms": (False, _NUM),
+        "retraces": (False, _NUM),
+        "reloads": (False, _NUM),
+        "params_version": (False, _NUM),
+        "sessions": (False, _NUM),
+    },
+    # checkpoint hot-reload attempts (serve/reload.py)
+    "reload": {
+        "action": (True, _STR),  # swapped | failed
+        "path": (False, _STR),
+        "step": (False, _NUM),
+        "params_version": (False, _NUM),
+        "error": (False, _STR),
+    },
 }
 
 

@@ -112,3 +112,26 @@ class CheckpointManager:
         with open(path, "rb") as f:
             payload = pickle.load(f)
         return _from_host(payload)
+
+    # top-level state keys that only training needs: optimizer moments and
+    # replay buffers dominate checkpoint size but are dead weight for
+    # inference (serving, evaluation, hot-reload)
+    TRAIN_ONLY_KEYS = ("rb", "opt_state", "opt_states")
+    TRAIN_ONLY_SUFFIXES = ("_opt_state", "_opt_states", "_opt", "optimizer")
+
+    @classmethod
+    def is_train_only_key(cls, key: str) -> bool:
+        k = str(key)
+        return k in cls.TRAIN_ONLY_KEYS or k.endswith(cls.TRAIN_ONLY_SUFFIXES)
+
+    @classmethod
+    def load_for_inference(cls, path: os.PathLike) -> Dict[str, Any]:
+        """Load a checkpoint for serving/evaluation: optimizer state and
+        replay buffers are dropped before the device conversion, so a policy
+        server never materializes training-only arrays (`_from_host` key
+        wrapping runs only on what survives)."""
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        if isinstance(payload, dict):
+            payload = {k: v for k, v in payload.items() if not cls.is_train_only_key(k)}
+        return _from_host(payload)

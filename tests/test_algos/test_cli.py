@@ -77,6 +77,18 @@ def test_eval_missing_checkpoint_errors():
         evaluation([])
 
 
+def test_eval_malformed_override_errors(trained_ckpt):
+    """An override without '=' must error loudly, not be dropped silently."""
+    with pytest.raises(ValueError, match="Malformed override"):
+        evaluation([f"checkpoint_path={trained_ckpt}", "metric.log_level"])
+
+
+def test_eval_applies_overrides(trained_ckpt):
+    # dry_run=True caps the greedy episode at one step — the override must
+    # actually land in the rebuilt config
+    evaluation([f"checkpoint_path={trained_ckpt}", "dry_run=True"])
+
+
 def test_registration_populates_registry(trained_ckpt):
     registration([f"checkpoint_path={trained_ckpt}"])
     entries = glob.glob("models_registry/ppo_discrete_dummy*/v1/params.pkl")

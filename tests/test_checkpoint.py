@@ -65,6 +65,33 @@ def test_atomic_write_leaves_no_tmp_on_success(tmp_path):
     assert leftovers == []
 
 
+def test_load_for_inference_drops_optimizer_state_and_buffer(tmp_path):
+    """The serving/eval load path must skip training-only state: optimizer
+    moments (`opt_state`/`opt_states`) and the replay buffer (`rb`) — while
+    keeping params, counters and a usable PRNG key."""
+    ckpt = CheckpointManager(str(tmp_path))
+    state = {
+        "params": {"w": np.full((2, 2), 3.0, np.float32)},
+        "opt_state": {"mu": np.zeros((2, 2), np.float32)},
+        "opt_states": {"wm": {"nu": np.zeros((4,), np.float32)}},
+        "rb": {"obs": np.zeros((128, 4), np.float32)},
+        "policy_step": 7,
+        "rng": jax.random.key(7),
+    }
+    path = ckpt.save(7, state)
+    lean = CheckpointManager.load_for_inference(path)
+    assert set(lean) == {"params", "policy_step", "rng"}
+    np.testing.assert_allclose(lean["params"]["w"], 3.0)
+    # the PRNG key still restores to a usable, reproducible key
+    np.testing.assert_allclose(
+        np.asarray(jax.random.uniform(lean["rng"])),
+        np.asarray(jax.random.uniform(jax.random.key(7))),
+    )
+    # the full loader still returns everything (resume path unchanged)
+    full = CheckpointManager.load(path)
+    assert set(full) == set(state)
+
+
 def test_failed_save_does_not_clobber_existing(tmp_path):
     ckpt = CheckpointManager(str(tmp_path))
     ckpt.save(7, _state(1.0))

@@ -92,3 +92,55 @@ def test_device_prefetcher_surfaces_worker_exception():
 def test_device_prefetcher_sync_get():
     pf = DevicePrefetcher(lambda: {"x": np.ones((3,), np.float32)})
     assert np.asarray(pf.get()["x"]).shape == (3,)
+
+
+def test_device_prefetcher_exception_keeps_raising_not_stopiteration():
+    """A dead worker must fail loudly on EVERY consumer call — the second
+    __next__ after an error must not degrade to a silent StopIteration."""
+
+    def sample():
+        raise RuntimeError("boom")
+
+    pf = DevicePrefetcher(sample).start()
+    with pytest.raises(RuntimeError, match="boom"):
+        next(pf)
+    with pytest.raises(RuntimeError, match="boom"):
+        next(pf)
+    pf.stop()
+
+
+def test_device_prefetcher_get_surfaces_worker_exception():
+    state = {"fail": True}
+
+    def sample():
+        if state["fail"]:
+            raise RuntimeError("boom")
+        return {"x": np.ones((2,), np.float32)}
+
+    pf = DevicePrefetcher(sample).start()
+    pf._thread.join(timeout=5.0)  # let the worker die
+    state["fail"] = False
+    with pytest.raises(RuntimeError, match="boom"):
+        pf.get()
+    pf.stop()
+
+
+def test_device_prefetcher_stop_releases_blocked_producer():
+    """stop() must drain the queue while joining so a worker blocked in
+    `put` on a full queue is released, not abandoned mid-join."""
+    import time
+
+    def sample():
+        return {"x": np.zeros((64,), np.float32)}
+
+    pf = DevicePrefetcher(sample, depth=1).start()
+    # let the worker fill the queue and block producing the NEXT batch
+    deadline = time.monotonic() + 5.0
+    while pf._q.qsize() < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    worker = pf._thread
+    t0 = time.monotonic()
+    pf.stop()
+    assert time.monotonic() - t0 < 2.0  # joined promptly
+    assert worker is not None and not worker.is_alive()
+    assert pf._q.empty()

@@ -1,0 +1,485 @@
+"""Serving subsystem tests (sheeprl_tpu/serve/): checkpoint→policy adapter,
+bucketed no-retrace compilation, micro-batching, backpressure, per-session
+recurrent state and checkpoint hot-reload. The end-to-end HTTP smoke test
+lives in test_serve_e2e.py (marked slow)."""
+import glob
+import os
+import pathlib
+import threading
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve import (
+    Backpressure,
+    CheckpointReloader,
+    InferencePolicy,
+    MicroBatcher,
+    PolicyCore,
+)
+from sheeprl_tpu.utils.checkpoint import CheckpointManager
+
+PPO_ARGS = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.total_steps=32",
+    "algo.run_test=False",
+    "buffer.memmap=False",
+    "metric.log_level=0",
+    "checkpoint.every=16",
+]
+
+
+@pytest.fixture(scope="module")
+def ppo_ckpt(tmp_path_factory):
+    """One tiny PPO checkpoint for the whole module (32 CPU steps)."""
+    from sheeprl_tpu.cli import run
+
+    root = tmp_path_factory.mktemp("serve_ppo")
+    old = os.getcwd()
+    os.chdir(root)
+    try:
+        run(PPO_ARGS)
+        ckpts = sorted(
+            glob.glob("logs/runs/ppo/discrete_dummy/*/version_*/checkpoint/ckpt_*.ckpt"),
+            key=lambda p: (os.path.dirname(p), int(pathlib.Path(p).stem.split("_")[1])),
+        )
+        assert ckpts, "training produced no checkpoint"
+        return (root / ckpts[-1]).resolve()
+    finally:
+        os.chdir(old)
+
+
+def _obs(n: int) -> dict:
+    return {"state": np.full((n, 10), 3.0, np.float32)}
+
+
+# -- InferencePolicy ---------------------------------------------------------
+
+
+def test_policy_from_checkpoint_serves_mixed_batches_without_retrace(ppo_ckpt):
+    policy = InferencePolicy.from_checkpoint(ppo_ckpt, buckets=[1, 2, 4, 8])
+    traces = policy.warmup()
+    assert traces == 8  # 4 buckets x 2 greedy variants
+    for n in (1, 2, 3, 5, 8):
+        actions = policy.act_batch(policy.prepare(_obs(n), n), n, deterministic=True)
+        assert actions.shape == (n, 1)
+        assert set(np.asarray(actions).ravel().tolist()) <= {0, 1}
+    # stochastic traffic too: every shape was pre-warmed, nothing recompiles
+    policy.act_batch(policy.prepare(_obs(3), 3), 3, deterministic=False)
+    assert policy.retraces_since_warmup() == 0
+
+
+def test_policy_oversized_batch_chunks_to_max_bucket(ppo_ckpt):
+    policy = InferencePolicy.from_checkpoint(ppo_ckpt, buckets=[1, 2, 4])
+    policy.warmup((True,))
+    actions = policy.act_batch(policy.prepare(_obs(11), 11), 11, deterministic=True)
+    assert actions.shape == (11, 1)
+    assert policy.retraces_since_warmup() == 0
+
+
+def test_malformed_obs_rejected_before_batching(ppo_ckpt):
+    """A wrong-shaped/dtyped request fails alone with ValueError — it never
+    joins a coalesced batch (where it would fail every rider) and never
+    reaches the device as an unwarmed shape."""
+    policy = InferencePolicy.from_checkpoint(ppo_ckpt, buckets=[1, 2])
+    policy.warmup((True,))
+    batcher = MicroBatcher(policy, max_wait_ms=0.0).start()
+    try:
+        with pytest.raises(ValueError, match="shape"):
+            batcher.submit({"state": np.zeros((5,), np.float32)}, deterministic=True)
+        # well-formed traffic still flows, and nothing recompiled
+        out = batcher.submit(_obs(1), deterministic=True)
+        assert out.shape == (1, 1)
+    finally:
+        batcher.stop()
+    assert policy.retraces_since_warmup() == 0
+
+
+def test_session_store_evicts_least_recently_used():
+    policy = InferencePolicy(
+        _counter_core(), {"w": np.zeros((1,), np.float32)}, buckets=[1]
+    )
+    policy.sessions.max_sessions = 2
+    policy.warmup((True,))
+    obs = {"x": [0.0]}
+    policy.act(obs, True, session="a")
+    policy.act(obs, True, session="b")
+    policy.act(obs, True, session="c")  # evicts a (LRU)
+    assert len(policy.sessions) == 2
+    assert policy.sessions.get("a") is None
+    assert float(policy.act(obs, True, session="b")[0, 0]) == 1.0  # b survived
+    assert float(policy.act(obs, True, session="a")[0, 0]) == 0.0  # a restarts
+
+
+def test_policy_act_single_request_deterministic_is_stable(ppo_ckpt):
+    policy = InferencePolicy.from_checkpoint(ppo_ckpt, buckets=[1, 2])
+    a1 = policy.act({"state": np.full((10,), 3.0, np.float32)}, deterministic=True)
+    a2 = policy.act({"state": np.full((10,), 3.0, np.float32)}, deterministic=True)
+    assert a1.shape == (1, 1)
+    np.testing.assert_array_equal(a1, a2)
+
+
+def test_load_for_inference_skips_optimizer_and_buffer(ppo_ckpt):
+    full = CheckpointManager.load(ppo_ckpt)
+    lean = CheckpointManager.load_for_inference(ppo_ckpt)
+    assert "opt_state" in full, "PPO checkpoints carry optimizer state"
+    assert "opt_state" not in lean and "rb" not in lean
+    assert "params" in lean and "policy_step" in lean
+
+
+def test_cli_serve_composes_serve_config(ppo_ckpt, monkeypatch):
+    """`sheeprl_tpu serve checkpoint_path=...` merges the serve config group
+    into the run's saved config and errors on malformed overrides."""
+    from sheeprl_tpu import cli
+
+    captured = {}
+    monkeypatch.setattr(
+        "sheeprl_tpu.serve.server.serve_from_checkpoint",
+        lambda ckpt, cfg, block=True: captured.update(ckpt=ckpt, cfg=cfg),
+    )
+    cli.serve([f"checkpoint_path={ppo_ckpt}", "serve.http.port=0", "serve.max_wait_ms=1.5"])
+    cfg = captured["cfg"]
+    assert list(cfg.select("serve.buckets")) == [1, 2, 4, 8, 16]
+    assert cfg.select("serve.http.port") == 0
+    assert cfg.select("serve.max_wait_ms") == 1.5
+    assert cfg.select("algo.name") == "ppo"  # run config still underneath
+    with pytest.raises(ValueError, match="Malformed override"):
+        cli.serve([f"checkpoint_path={ppo_ckpt}", "serve.http.port"])
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        cli.serve([])
+
+
+# -- hot reload --------------------------------------------------------------
+
+
+def _perturbed_state(ckpt_path: pathlib.Path, delta: float = 1.0) -> dict:
+    state = CheckpointManager.load(ckpt_path)
+    state["params"] = __import__("jax").tree.map(
+        lambda x: np.asarray(x) + delta if np.issubdtype(np.asarray(x).dtype, np.floating) else x,
+        state["params"],
+    )
+    return state
+
+
+def test_hot_reload_swaps_params_without_dropping_requests(ppo_ckpt):
+    policy = InferencePolicy.from_checkpoint(ppo_ckpt, buckets=[1, 2, 4])
+    policy.warmup((True,))
+    import jax
+
+    leaf_before = np.asarray(jax.tree.leaves(policy.current_params()[0])[0]).copy()
+    step = int(ppo_ckpt.stem.split("_")[1])
+    reloader = CheckpointReloader(policy, ppo_ckpt.parent, loaded_step=step)
+
+    errors: list = []
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                policy.act_batch(policy.prepare(_obs(2), 2), 2, deterministic=True)
+            except BaseException as e:  # pragma: no cover - failure path
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        # write a newer checkpoint with visibly different params mid-stream
+        mgr = CheckpointManager(str(ppo_ckpt.parent.parent))
+        mgr.save(step + 1, _perturbed_state(ppo_ckpt))
+        assert reloader.poll_once(), "reloader must pick up the newer checkpoint"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+    assert not errors, f"in-flight requests errored during swap: {errors}"
+    assert policy.reload_count == 1 and policy.params_version == 1
+    leaf_after = np.asarray(jax.tree.leaves(policy.current_params()[0])[0])
+    np.testing.assert_allclose(leaf_after, leaf_before + 1.0, rtol=1e-6)
+    # the swapped policy still serves every warmed shape without a retrace
+    policy.act_batch(policy.prepare(_obs(3), 3), 3, deterministic=True)
+    assert policy.retraces_since_warmup() == 0
+
+
+def test_reloader_ignores_older_and_corrupt_checkpoints(ppo_ckpt, tmp_path):
+    from sheeprl_tpu.serve.reload import _list_checkpoints
+
+    policy = InferencePolicy.from_checkpoint(ppo_ckpt, buckets=[1])
+    # anchor at the newest checkpoint present (earlier tests may have
+    # written newer ones into the shared module fixture dir)
+    step = _list_checkpoints(ppo_ckpt.parent)[-1][0]
+    reloader = CheckpointReloader(policy, ppo_ckpt.parent, loaded_step=step)
+    assert not reloader.poll_once()  # nothing newer
+    bad = ppo_ckpt.parent / f"ckpt_{step + 5}.ckpt"
+    bad.write_bytes(b"not a pickle")
+    try:
+        assert not reloader.poll_once()  # corrupt file reported, not fatal
+        assert policy.reload_count == 0
+        assert reloader.loaded_step == step + 5  # and not retried forever
+    finally:
+        bad.unlink()
+
+
+# -- micro-batching ----------------------------------------------------------
+
+
+def test_batcher_coalesces_concurrent_requests(ppo_ckpt):
+    policy = InferencePolicy.from_checkpoint(ppo_ckpt, buckets=[1, 2, 4, 8])
+    policy.warmup((True, False))
+    batcher = MicroBatcher(policy, max_wait_ms=100.0, max_pending=64).start()
+    results: dict = {}
+
+    def client(i: int):
+        results[i] = batcher.submit(_obs(1), deterministic=True)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(24)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    batcher.stop()
+    assert len(results) == 24
+    assert all(r.shape == (1, 1) for r in results.values())
+    snap = batcher.stats.snapshot()
+    assert snap["completed"] == 24
+    # 24 near-simultaneous requests under a 100ms deadline must coalesce
+    assert snap["batches"] < 24
+    assert snap["avg_batch_size"] > 1.0
+    assert policy.retraces_since_warmup() == 0
+
+
+class _BlockingPolicy:
+    """Minimal InferencePolicy stand-in whose act_batch blocks on an event."""
+
+    def __init__(self):
+        self.buckets = [1, 2, 4]
+        self.entered = threading.Event()  # set when act_batch starts
+        self.release = threading.Event()
+        self.sessions = {}
+        self.reload_count = 0
+        self.params_version = 0
+
+    def prepare(self, raw, n):
+        return {"x": np.zeros((n, 1), np.float32)}
+
+    def act_batch(self, obs, n, deterministic=False, sessions=None):
+        self.entered.set()
+        assert self.release.wait(30.0)
+        return np.zeros((n, 1), np.float32)
+
+    def retraces_since_warmup(self):
+        return 0
+
+
+def test_backpressure_rejects_with_retry_after():
+    policy = _BlockingPolicy()
+    batcher = MicroBatcher(policy, max_wait_ms=0.0, max_pending=3).start()
+    threads = [threading.Thread(target=lambda: batcher.submit({"x": [0.0]}), daemon=True)]
+    try:
+        # first request alone gets taken into a batch that then blocks...
+        threads[0].start()
+        assert policy.entered.wait(10.0)
+        # ...so these three fill the bounded queue exactly
+        more = [
+            threading.Thread(target=lambda: batcher.submit({"x": [0.0]}), daemon=True)
+            for _ in range(3)
+        ]
+        threads += more
+        for t in more:
+            t.start()
+        deadline = __import__("time").monotonic() + 10.0
+        while batcher.queue_depth < 3 and __import__("time").monotonic() < deadline:
+            __import__("time").sleep(0.01)
+        assert batcher.queue_depth == 3
+        with pytest.raises(Backpressure) as exc:
+            batcher.submit({"x": [0.0]})
+        assert exc.value.retry_after_s > 0
+        assert batcher.stats.snapshot()["rejected"] == 1
+    finally:
+        policy.release.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        batcher.stop()
+
+
+def test_batcher_groups_by_deterministic_flag():
+    calls: list = []
+
+    class _FlagPolicy(_BlockingPolicy):
+        def __init__(self):
+            super().__init__()
+            self.release.set()
+
+        def act_batch(self, obs, n, deterministic=False, sessions=None):
+            calls.append((n, deterministic))
+            return np.zeros((n, 1), np.float32)
+
+    policy = _FlagPolicy()
+    batcher = MicroBatcher(policy, max_wait_ms=200.0, max_pending=64)
+    # enqueue directly (no flush thread yet): det, det, stoch, det
+    flags = [True, True, False, True]
+    reqs = []
+    from sheeprl_tpu.serve.batcher import _Request
+
+    for f in flags:
+        reqs.append(_Request(policy.prepare({"x": [0.0]}, 1), f, None))
+    batcher._pending.extend(reqs)
+    with batcher._cv:
+        first = batcher._take_batch_locked()
+    assert [r.deterministic for r in first] == [True, True]  # stops at the flip
+    with batcher._cv:
+        second = batcher._take_batch_locked()
+    assert [r.deterministic for r in second] == [False]
+
+
+def test_batcher_propagates_policy_error_to_caller():
+    class _FailingPolicy(_BlockingPolicy):
+        def act_batch(self, obs, n, deterministic=False, sessions=None):
+            raise ValueError("bad obs shape")
+
+    batcher = MicroBatcher(_FailingPolicy(), max_wait_ms=0.0).start()
+    try:
+        with pytest.raises(ValueError, match="bad obs shape"):
+            batcher.submit({"x": [0.0]})
+    finally:
+        batcher.stop()
+    snap = batcher.stats.snapshot()
+    assert snap["errors"] == 1 and snap["completed"] == 0
+
+
+# -- per-session recurrent state --------------------------------------------
+
+
+def _counter_core() -> PolicyCore:
+    """Stateful fake: state counts the steps of each session; the action
+    echoes the pre-step counter, so session isolation is observable."""
+    return PolicyCore(
+        apply=lambda params, obs, state, key, greedy: (state, state + 1.0, key),
+        extract_params=lambda p: p,
+        prepare=lambda raw, n: np.asarray(raw["x"], np.float32).reshape(n, -1),
+        dummy_obs=lambda n: np.zeros((n, 1), np.float32),
+        init_state=lambda params, n: __import__("jax").numpy.zeros((n, 1)),
+        name="counter",
+    )
+
+
+def test_sessions_carry_recurrent_state_across_requests():
+    policy = InferencePolicy(_counter_core(), {"w": np.zeros((1,), np.float32)}, buckets=[1, 2, 4])
+    policy.warmup((True,))
+    obs = {"x": [0.0]}
+    assert float(policy.act(obs, True, session="a")[0, 0]) == 0.0
+    assert float(policy.act(obs, True, session="a")[0, 0]) == 1.0
+    assert float(policy.act(obs, True, session="b")[0, 0]) == 0.0  # isolated
+    assert float(policy.act(obs, True, session="a")[0, 0]) == 2.0
+    # sessionless requests act from a fresh state and persist nothing
+    assert float(policy.act(obs, True, session=None)[0, 0]) == 0.0
+    assert len(policy.sessions) == 2
+    policy.sessions.drop("a")
+    assert float(policy.act(obs, True, session="a")[0, 0]) == 0.0
+
+
+def test_session_state_survives_batched_mixed_sessions():
+    policy = InferencePolicy(_counter_core(), {"w": np.zeros((1,), np.float32)}, buckets=[1, 2, 4])
+    policy.warmup((True,))
+    # step sessions a,b,c together twice with padding (3 rows in bucket 4)
+    obs3 = policy.prepare({"x": [[0.0], [0.0], [0.0]]}, 3)
+    first = policy.act_batch(obs3, 3, True, sessions=["a", "b", "c"])
+    np.testing.assert_allclose(first, np.zeros((3, 1)))
+    second = policy.act_batch(obs3, 3, True, sessions=["a", "b", "c"])
+    np.testing.assert_allclose(second, np.ones((3, 1)))
+    # and a's counter is correct when it rides a different batch mix
+    third = policy.act_batch(policy.prepare({"x": [[0.0]]}, 1), 1, True, sessions=["a"])
+    np.testing.assert_allclose(third, np.full((1, 1), 2.0))
+    assert policy.retraces_since_warmup() == 0
+
+
+def test_hot_reload_resets_nothing_for_sessions():
+    """A param swap must not clobber live session state (double-buffered
+    params, untouched sessions)."""
+    policy = InferencePolicy(_counter_core(), {"w": np.zeros((1,), np.float32)}, buckets=[1])
+    policy.warmup((True,))
+    obs = {"x": [0.0]}
+    policy.act(obs, True, session="a")
+    policy.act(obs, True, session="a")
+    policy.swap_params({"w": np.ones((1,), np.float32)})
+    assert float(policy.act(obs, True, session="a")[0, 0]) == 2.0
+
+
+# -- DreamerV3: real recurrent policy ---------------------------------------
+
+DV3_ARGS = [
+    "exp=dreamer_v3",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=1",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "algo=dreamer_v3_XS",
+    "algo.dense_units=16",
+    "algo.mlp_layers=1",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=16",
+    "algo.world_model.transition_model.hidden_size=16",
+    "algo.world_model.representation_model.hidden_size=16",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "buffer.memmap=False",
+    "metric.log_level=0",
+]
+
+
+def test_dreamer_v3_policy_carries_recurrent_session_state():
+    """The DreamerV3 builder: latent (h, z, a) rides the session store, and
+    mixed-session batches stay within the warmed bucket compilations."""
+    import jax
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.serve.builders import _HostDist
+    from sheeprl_tpu.utils.env import vectorize
+
+    cfg = compose("config", DV3_ARGS)
+    env = vectorize(cfg, cfg.seed, 0).envs[0]
+    obs_space, act_space = env.observation_space, env.action_space
+    env.close()
+    wm, actor, critic, params = build_agent(
+        _HostDist(), cfg, obs_space, [int(act_space.n)], False, jax.random.key(0)
+    )
+    policy = InferencePolicy.from_state(cfg, params, obs_space, act_space, buckets=[1, 2])
+    assert policy.core.stateful
+    policy.warmup((True,))
+
+    raw = {k: np.zeros(obs_space[k].shape, obs_space[k].dtype) for k in ("rgb", "state")}
+    a1 = policy.act(raw, deterministic=True, session="a")
+    assert a1.shape == (1, 1)
+    row = policy.sessions.get("a")
+    assert row is not None
+    h, z, _ = row
+    assert float(np.abs(np.asarray(h)).sum()) > 0  # latent moved off init
+    # a second step from the stored latent, batched with a fresh session
+    raw2 = {
+        "rgb": np.zeros((2, *obs_space["rgb"].shape), obs_space["rgb"].dtype),
+        "state": np.zeros((2, *obs_space["state"].shape), obs_space["state"].dtype),
+    }
+    actions = policy.act_batch(policy.prepare(raw2, 2), 2, True, sessions=["a", "b"])
+    assert actions.shape == (2, 1)
+    assert policy.retraces_since_warmup() == 0
+    # params from the checkpoint layout {wm, actor, critic, target_critic}
+    # were pruned to the inference subtree
+    served, _ = policy.current_params()
+    assert set(served) == {"wm", "actor"}
