@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Preemption smoke test: real SIGTERM, real resume (resilience subsystem).
+
+Drives the full fleet-preemption story with actual process signals — the
+thing the in-process tier-1 tests approximate with the maintenance poller:
+
+1. spawn `sheeprl_tpu run exp=ppo env=dummy ...` as a child process;
+2. once training is in steady state (first telemetry `log` line), deliver
+   SIGTERM and wait for a clean exit;
+3. assert a complete checkpoint + resume manifest landed inside the grace
+   window;
+4. run `sheeprl_tpu resume run_dir=...` and assert training continues to
+   the configured total step with the preempted leg's state.
+
+Prints one JSON verdict line on stdout (`{"ok": true, ...}`), exit code 0 on
+success — the contract `tests/test_resilience.py::test_preempt_smoke_script_*`
+(slow marker) checks. Run it from any scratch directory:
+
+    JAX_PLATFORMS=cpu python scripts/preempt_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+TOTAL_STEPS = 512
+RUN_NAME = "preempt_smoke"
+BASE = pathlib.Path("logs/runs/ppo/discrete_dummy") / RUN_NAME
+
+def _by_step(p: pathlib.Path) -> int:
+    return int(p.stem.split("_")[1])
+
+
+TRAIN_ARGS = [
+    "exp=ppo",
+    "env=dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "metric.log_level=1",
+    "metric.log_every=32",
+    f"algo.total_steps={TOTAL_STEPS}",
+    "algo.rollout_steps=16",
+    "algo.update_epochs=1",
+    "algo.per_rank_batch_size=8",
+    "algo.encoder.cnn_features_dim=16",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.run_test=False",
+    "checkpoint.every=100000",  # only the SIGTERM drain writes
+    "checkpoint.save_last=True",
+    "model_manager.disabled=True",
+    f"run_name={RUN_NAME}",
+    "resilience.preemption.grace_s=60.0",
+]
+
+
+def _spawn(cmd):
+    return subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
+    )
+
+
+def _fail(msg, **extra):
+    print(json.dumps({"ok": False, "error": msg, **extra}))
+    sys.exit(1)
+
+
+def main() -> None:
+    # -- leg 1: train, SIGTERM mid-run ------------------------------------
+    child = _spawn([sys.executable, "-m", "sheeprl_tpu", "run", *TRAIN_ARGS])
+    saw_progress = False
+    deadline = time.monotonic() + 600
+    assert child.stdout is not None
+    for line in child.stdout:
+        if time.monotonic() > deadline:
+            child.kill()
+            _fail("training produced no progress within 600s")
+        # first interval heartbeat == steady state (past compile)
+        if "[telemetry rank=0] step=" in line:
+            saw_progress = True
+            break
+    if not saw_progress:
+        _fail("child exited before reaching steady state", rc=child.wait())
+    child.send_signal(signal.SIGTERM)
+    t_term = time.monotonic()
+    try:
+        rc = child.wait(timeout=120)
+    except subprocess.TimeoutExpired:
+        child.kill()
+        _fail("child did not drain within 120s of SIGTERM")
+    drain_s = time.monotonic() - t_term
+    child.stdout.close()
+
+    ckpts = sorted((BASE / "version_0" / "checkpoint").glob("ckpt_*.ckpt"), key=_by_step)
+    if not ckpts:
+        _fail("no checkpoint after SIGTERM", rc=rc, drain_s=drain_s)
+    preempt_step = _by_step(ckpts[-1])
+    manifest_path = BASE / "version_0" / "resume_manifest.json"
+    if not manifest_path.is_file():
+        _fail("no resume manifest after SIGTERM")
+    manifest = json.loads(manifest_path.read_text())
+    telem = BASE / "version_0" / "telemetry.jsonl"
+    events = [json.loads(ln) for ln in telem.read_text().splitlines() if ln.strip()]
+    preempt_actions = [e["action"] for e in events if e.get("event") == "preempt"]
+    if "checkpointed" not in preempt_actions:
+        _fail("preempt drain did not record a checkpoint", actions=preempt_actions)
+
+    # -- leg 2: resume to the target step ---------------------------------
+    res = subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu", "resume", f"run_dir={BASE}"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
+    )
+    if res.returncode != 0:
+        _fail("resume leg failed", rc=res.returncode)
+    final_ckpts = sorted((BASE / "version_1" / "checkpoint").glob("ckpt_*.ckpt"), key=_by_step)
+    if not final_ckpts:
+        _fail("resume leg wrote no checkpoint")
+    final_step = _by_step(final_ckpts[-1])
+    if final_step < TOTAL_STEPS:
+        _fail("resume leg stopped short", final_step=final_step)
+
+    print(
+        json.dumps(
+            {
+                "ok": True,
+                "preempt_step": preempt_step,
+                "final_step": final_step,
+                "drain_s": round(drain_s, 2),
+                "manifest_step": manifest["step"],
+                "rc_after_sigterm": rc,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

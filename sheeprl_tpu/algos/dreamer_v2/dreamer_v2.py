@@ -44,8 +44,9 @@ from ...utils.env import episode_stats, patch_restarted_envs, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
+from ...resilience import RunGuard
 from ...utils import run_info
-from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
+from ...utils.utils import Ratio, save_configs
 from .agent import (
     DV2Actor,
     DV2WorldModel,
@@ -497,6 +498,8 @@ def main(dist: Distributed, cfg: Config) -> None:
     telem = Telemetry.setup(cfg, log_dir, rank, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
     aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
+    guard = RunGuard.setup(cfg, ckpt, telem, log_dir)
+    ckpt = guard.ckpt
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
@@ -557,10 +560,9 @@ def main(dist: Distributed, cfg: Config) -> None:
             s["rb"] = rb.checkpoint_state_dict()
         return s
 
-    wall = WallClockStopper(cfg)
     while policy_step < total_steps:
         telem.tick(policy_step)
-        if wall_cap_reached(wall, policy_step, total_steps, ckpt, _ckpt_state, cfg):
+        if guard.stop_reached(policy_step, total_steps, _ckpt_state):
             break
         with telem.span("Time/env_interaction_time"):
             if policy_step <= learning_starts:
@@ -671,6 +673,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             last_checkpoint = policy_step
             ckpt.save(policy_step, _ckpt_state())
 
+    guard.close(policy_step, _ckpt_state)
     envs.close()
     telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:

@@ -51,8 +51,9 @@ from ...utils.env import episode_stats, patch_restarted_envs, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
+from ...resilience import RunGuard
 from ...utils import run_info
-from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
+from ...utils.utils import Ratio, save_configs
 from .agent import Actor, WorldModel, build_agent, compute_stochastic_state, sample_actor_actions
 from .loss import reconstruction_loss
 from .utils import (
@@ -549,6 +550,8 @@ def main(dist: Distributed, cfg: Config) -> None:
     telem = Telemetry.setup(cfg, log_dir, rank, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
     aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
+    guard = RunGuard.setup(cfg, ckpt, telem, log_dir)
+    ckpt = guard.ckpt
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
@@ -605,12 +608,11 @@ def main(dist: Distributed, cfg: Config) -> None:
 
     # SHEEPRL_TPU_PROGRESS=N: wall-clock trace every N policy steps (stderr)
     _progress = int(os.environ.get("SHEEPRL_TPU_PROGRESS", "0") or 0)
-    wall = WallClockStopper(cfg)
     _t0 = time.perf_counter()
 
     while policy_step < total_steps:
         telem.tick(policy_step)
-        if wall_cap_reached(wall, policy_step, total_steps, ckpt, _ckpt_state, cfg):
+        if guard.stop_reached(policy_step, total_steps, _ckpt_state):
             break
         if _progress and policy_step % _progress < num_envs:
             print(
@@ -760,6 +762,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             last_checkpoint = policy_step
             ckpt.save(policy_step, _ckpt_state())
 
+    guard.close(policy_step, _ckpt_state)
     envs.close()
     telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:

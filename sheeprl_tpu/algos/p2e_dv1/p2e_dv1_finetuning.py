@@ -30,7 +30,8 @@ from ...utils.env import episode_stats, patch_restarted_envs, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm
-from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
+from ...resilience import RunGuard
+from ...utils.utils import Ratio, save_configs
 from ..dreamer_v1.agent import build_agent as dv1_build_agent
 from ..dreamer_v1.dreamer_v1 import make_player, make_train_fn
 from ..dreamer_v1.utils import AGGREGATOR_KEYS as _DV1_KEYS, prepare_obs, test  # noqa: F401
@@ -138,6 +139,8 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
     telem = Telemetry.setup(cfg, log_dir, rank, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
     aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
+    guard = RunGuard.setup(cfg, ckpt, telem, log_dir)
+    ckpt = guard.ckpt
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
@@ -206,10 +209,9 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
             s["rb"] = rb.checkpoint_state_dict()
         return s
 
-    wall = WallClockStopper(cfg)
     while policy_step < total_steps:
         telem.tick(policy_step)
-        if wall_cap_reached(wall, policy_step, total_steps, ckpt, _ckpt_state, cfg):
+        if guard.stop_reached(policy_step, total_steps, _ckpt_state):
             break
         with telem.span("Time/env_interaction_time"):
             # the prefill uses the exploration policy; once learning starts,
@@ -304,6 +306,7 @@ def main(dist: Distributed, cfg: Config, exploration_cfg: Config) -> None:
             last_checkpoint = policy_step
             ckpt.save(policy_step, _ckpt_state())
 
+    guard.close(policy_step, _ckpt_state)
     envs.close()
     telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:

@@ -40,8 +40,9 @@ from ...utils.env import episode_stats, vectorize
 from ...telemetry import Telemetry
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.registry import register_algorithm, register_evaluation
+from ...resilience import RunGuard
 from ...utils import run_info
-from ...utils.utils import Ratio, WallClockStopper, linear_annealing, save_configs, wall_cap_reached
+from ...utils.utils import Ratio, linear_annealing, save_configs
 from .agent import PPOAgent, actions_and_log_probs, build_agent
 from .loss import entropy_loss, policy_loss, value_loss
 from .utils import AGGREGATOR_KEYS, prepare_obs, test
@@ -191,6 +192,8 @@ def main(dist: Distributed, cfg: Config) -> None:
     telem = Telemetry.setup(cfg, log_dir, rank, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
     aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
+    guard = RunGuard.setup(cfg, ckpt, telem, log_dir)
+    ckpt = guard.ckpt
 
     # -- counters ----------------------------------------------------------
     policy_steps_per_iter = num_envs * rollout_steps
@@ -213,7 +216,6 @@ def main(dist: Distributed, cfg: Config) -> None:
             "rng": root_key,
         }
 
-    wall = WallClockStopper(cfg)
     for update_iter in range(start_iter, num_updates + 1):
         telem.tick(policy_step)
         with telem.span("Time/env_interaction_time"):
@@ -327,9 +329,10 @@ def main(dist: Distributed, cfg: Config) -> None:
             last_checkpoint = policy_step
             ckpt.save(policy_step, _ckpt_state())
 
-        if wall_cap_reached(wall, policy_step, int(cfg.algo.total_steps), ckpt, _ckpt_state, cfg):
+        if guard.stop_reached(policy_step, int(cfg.algo.total_steps), _ckpt_state):
             break
 
+    guard.close(policy_step, _ckpt_state)
     envs.close()
     telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:

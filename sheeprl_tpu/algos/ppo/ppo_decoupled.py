@@ -42,7 +42,8 @@ from ...utils.env import episode_stats, vectorize
 from ...telemetry import Telemetry
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.registry import register_algorithm
-from ...utils.utils import WallClockStopper, linear_annealing, save_configs, wall_cap_reached
+from ...resilience import RunGuard
+from ...utils.utils import linear_annealing, save_configs
 from .agent import build_agent
 from .ppo import make_act_fn, make_update_fn, make_value_fn
 from .utils import AGGREGATOR_KEYS, prepare_obs, test
@@ -184,9 +185,15 @@ def _player_loop(
             mirror.refresh(new_params)
 
         envs.close()
-        data_q.put(None)  # rollout source exhausted
+        try:  # nowait: the trainer may have left an unconsumed rollout behind
+            data_q.put_nowait(None)  # rollout source exhausted
+        except queue.Full:
+            pass
     except BaseException as e:  # surface crashes to the trainer
-        data_q.put(e)
+        try:
+            data_q.put(e, timeout=30)
+        except queue.Full:
+            pass
         raise
 
 
@@ -231,6 +238,8 @@ def main(dist: Distributed, cfg: Config) -> None:
     telem = Telemetry.setup(cfg, log_dir, 0, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
     aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=True)
+    guard = RunGuard.setup(cfg, ckpt, telem, log_dir)
+    ckpt = guard.ckpt
 
     policy_steps_per_iter = num_envs * rollout_steps
     num_updates = int(cfg.algo.total_steps) // policy_steps_per_iter if not cfg.dry_run else 1
@@ -264,10 +273,11 @@ def main(dist: Distributed, cfg: Config) -> None:
             "rng": root_key,
         }
 
-    wall = WallClockStopper(cfg)
     try:
         for update_iter in range(start_iter, num_updates + 1):
-            item = data_q.get()
+            # preemption-aware wait: a SIGTERM (or watchdog escalation)
+            # unparks the trainer even if the player thread is dead
+            item = guard.wait(data_q)
             if item is None:
                 break
             if isinstance(item, BaseException):
@@ -321,7 +331,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             # params_q.get(), so the finally-block sentinel lands on an empty
             # queue and the player exits cleanly (and the shared state the
             # checkpoint snapshots is quiescent)
-            if wall_cap_reached(wall, policy_step, int(cfg.algo.total_steps), ckpt, _ckpt_state, cfg):
+            if guard.stop_reached(policy_step, int(cfg.algo.total_steps), _ckpt_state):
                 break
             params_q.put(params)
     finally:
@@ -331,6 +341,7 @@ def main(dist: Distributed, cfg: Config) -> None:
         except queue.Full:
             pass
     player.join(timeout=60)
+    guard.close(policy_step, _ckpt_state)
     telem.close(policy_step)
 
     if cfg.algo.run_test:

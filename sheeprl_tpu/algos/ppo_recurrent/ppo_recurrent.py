@@ -40,7 +40,8 @@ from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.registry import register_algorithm, register_evaluation
-from ...utils.utils import WallClockStopper, linear_annealing, save_configs, wall_cap_reached
+from ...resilience import RunGuard
+from ...utils.utils import linear_annealing, save_configs
 from ..ppo.loss import entropy_loss, policy_loss, value_loss
 from .agent import RecurrentPPOAgent, actions_and_log_probs, build_agent
 from .utils import AGGREGATOR_KEYS, prepare_obs, test
@@ -208,6 +209,8 @@ def main(dist: Distributed, cfg: Config) -> None:
     telem = Telemetry.setup(cfg, log_dir, rank, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
     aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
+    guard = RunGuard.setup(cfg, ckpt, telem, log_dir)
+    ckpt = guard.ckpt
 
     policy_steps_per_iter = num_envs * rollout_steps
     num_updates = int(cfg.algo.total_steps) // policy_steps_per_iter if not cfg.dry_run else 1
@@ -246,7 +249,6 @@ def main(dist: Distributed, cfg: Config) -> None:
             "rng": root_key,
         }
 
-    wall = WallClockStopper(cfg)
     for update_iter in range(start_iter, num_updates + 1):
         telem.tick(policy_step)
         chunk_cx: list = []
@@ -403,9 +405,10 @@ def main(dist: Distributed, cfg: Config) -> None:
             last_checkpoint = policy_step
             ckpt.save(policy_step, _ckpt_state())
 
-        if wall_cap_reached(wall, policy_step, int(cfg.algo.total_steps), ckpt, _ckpt_state, cfg):
+        if guard.stop_reached(policy_step, int(cfg.algo.total_steps), _ckpt_state):
             break
 
+    guard.close(policy_step, _ckpt_state)
     envs.close()
     telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:

@@ -32,7 +32,8 @@ from ...utils.env import episode_stats, vectorize
 from ...telemetry import Telemetry
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.registry import register_algorithm
-from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
+from ...resilience import RunGuard
+from ...utils.utils import Ratio, save_configs
 from .agent import build_agent, sample_actions
 from .sac import make_train_fn
 from .utils import AGGREGATOR_KEYS, flatten_obs, test
@@ -54,7 +55,7 @@ def _player_loop(
     world_size: int,
     state,
     seed_key,
-    wall: WallClockStopper,
+    guard: RunGuard,
 ) -> None:
     """Env stepping + buffer ownership (reference player(), :53-338)."""
     try:
@@ -101,13 +102,13 @@ def _player_loop(
         obs_vec = flatten_obs(obs, mlp_keys, num_envs)
 
         while policy_step < total_steps:
-            # the wall cap must hold during warmup too: before learning_starts
-            # the trainer is parked in data_q.get() and its own check never
-            # runs, so an uncapped warmup would overshoot the budget (the
-            # shared stopper makes both sides agree on one clock)
-            if wall_cap_reached(
-                wall, policy_step, total_steps, None, None, cfg, save=False
-            ):
+            # the wall cap AND preemption drain must hold during warmup
+            # too: before learning_starts the trainer is parked in
+            # data_q.get() and its own check never runs, so an uncapped
+            # warmup would overshoot the budget (the shared guard makes both
+            # sides agree on one clock/flag); save=False — the final
+            # checkpoint belongs to the trainer after the join below
+            if guard.stop_reached(policy_step, total_steps, None, save=False):
                 break
             with telem.span("Time/env_interaction_time"):
                 if policy_step <= learning_starts:
@@ -167,9 +168,15 @@ def _player_loop(
                     mirror.refresh(new_actor_params)
 
         envs.close()
-        data_q.put(None)
+        try:  # nowait: the trainer may have left an unconsumed batch behind
+            data_q.put_nowait(None)
+        except queue.Full:
+            pass
     except BaseException as e:
-        data_q.put(e)
+        try:
+            data_q.put(e, timeout=30)
+        except queue.Full:
+            pass
         raise
 
 
@@ -220,19 +227,20 @@ def main(dist: Distributed, cfg: Config) -> None:
     telem = Telemetry.setup(cfg, log_dir, 0, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
     aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=True)
+    guard = RunGuard.setup(cfg, ckpt, telem, log_dir)
+    ckpt = guard.ckpt
     last_log = state["last_log"] if state else 0
     last_checkpoint = state["last_checkpoint"] if state else 0
     cumulative_grad_steps = state["cumulative_grad_steps"] if state else 0
 
     data_q: "queue.Queue" = queue.Queue(maxsize=1)
     params_q: "queue.Queue" = queue.Queue(maxsize=1)
-    wall = WallClockStopper(cfg)
     player = threading.Thread(
         target=_player_loop,
         name="sac-player",
         args=(
             cfg, actor, params["actor"], log_dir, telem, data_q, params_q,
-            batch_size, dist.world_size, state, player_key, wall,
+            batch_size, dist.world_size, state, player_key, guard,
         ),
         daemon=True,
     )
@@ -259,7 +267,9 @@ def main(dist: Distributed, cfg: Config) -> None:
 
     try:
         while True:
-            item = data_q.get()
+            # preemption-aware wait: a SIGTERM (or watchdog escalation)
+            # unparks the trainer even if the player thread is dead
+            item = guard.wait(data_q)
             if item is None:
                 break
             if isinstance(item, BaseException):
@@ -304,9 +314,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             # params_q.get(), so the finally-block sentinel lands on an empty
             # queue and the player exits cleanly; the final save happens in
             # the save_last tail below, after the player thread has joined
-            if wall_cap_reached(
-                wall, policy_step, int(cfg.algo.total_steps), ckpt, _ckpt_state, cfg, save=False
-            ):
+            if guard.stop_reached(policy_step, int(cfg.algo.total_steps), _ckpt_state, save=False):
                 break
             params_q.put(params["actor"])
     finally:
@@ -315,12 +323,13 @@ def main(dist: Distributed, cfg: Config) -> None:
         except queue.Full:
             pass
     player.join(timeout=60)
-    telem.close(policy_step)
 
     # final checkpoint (reference :322-338 on_checkpoint_player save_last);
     # runs after player.join, so the buffer snapshot is quiescent
     if cfg.checkpoint.save_last:
         ckpt.save(policy_step, _ckpt_state())
+    guard.close(policy_step, _ckpt_state)
+    telem.close(policy_step)
 
     if cfg.algo.run_test:
         test_env = vectorize(

@@ -31,7 +31,8 @@ from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.registry import register_algorithm, register_evaluation
-from ...utils.utils import Ratio, WallClockStopper, save_configs, wall_cap_reached
+from ...resilience import RunGuard
+from ...utils.utils import Ratio, save_configs
 from ..sac.loss import critic_loss, entropy_loss, policy_loss
 from .agent import build_agent
 from .utils import AGGREGATOR_KEYS, preprocess_obs, prepare_obs_np, sample_actions_features, test
@@ -244,6 +245,8 @@ def main(dist: Distributed, cfg: Config) -> None:
     telem = Telemetry.setup(cfg, log_dir, rank, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
     aggregator = telem.aggregator
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
+    guard = RunGuard.setup(cfg, ckpt, telem, log_dir)
+    ckpt = guard.ckpt
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
@@ -289,10 +292,9 @@ def main(dist: Distributed, cfg: Config) -> None:
             s["rb"] = rb.checkpoint_state_dict()
         return s
 
-    wall = WallClockStopper(cfg)
     while policy_step < total_steps:
         telem.tick(policy_step)
-        if wall_cap_reached(wall, policy_step, total_steps, ckpt, _ckpt_state, cfg):
+        if guard.stop_reached(policy_step, total_steps, _ckpt_state):
             break
         with telem.span("Time/env_interaction_time"):
             if policy_step <= learning_starts:
@@ -358,6 +360,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             last_checkpoint = policy_step
             ckpt.save(policy_step, _ckpt_state())
 
+    guard.close(policy_step, _ckpt_state)
     envs.close()
     telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:

@@ -122,10 +122,12 @@ def run_algorithm(cfg: Config) -> None:
     # class-level switches are assigned both ways so a run never inherits
     # them from an earlier run in the same process (reference runs are
     # one-process-per-run; in-process callers like tests are not)
+    from .data.buffers import ReplayBuffer
     from .utils.metric import MetricAggregator
 
     MetricAggregator.disabled = cfg.select("metric.log_level", 1) == 0
     timer.disabled = bool(cfg.select("metric.disable_timer", False))
+    ReplayBuffer.memmap_fast_resume = bool(cfg.select("buffer.memmap_fast_resume", False))
     import contextlib
 
     ctx: Any = contextlib.nullcontext()
@@ -140,8 +142,22 @@ def run_algorithm(cfg: Config) -> None:
             or f"logs/profiler/{cfg.root_dir}/{cfg.run_name}"  # unique per run
         )
         ctx = jax.profiler.trace(trace_dir)
+    attempts = int(cfg.select("resilience.supervisor.attempts", 1) or 1)
     with ctx:
-        fn(dist, cfg, **kwargs)
+        if attempts > 1:
+            # restart-with-backoff + auto-resume from the newest checkpoint
+            # the crashed attempt left behind (resilience/supervisor.py)
+            from .resilience.supervisor import supervise
+
+            supervise(
+                lambda c: fn(dist, c, **kwargs),
+                cfg,
+                attempts=attempts,
+                backoff_s=float(cfg.select("resilience.supervisor.backoff_s", 5.0)),
+                max_backoff_s=float(cfg.select("resilience.supervisor.max_backoff_s", 120.0)),
+            )
+        else:
+            fn(dist, cfg, **kwargs)
 
 
 def eval_algorithm(cfg: Config) -> None:
@@ -249,6 +265,22 @@ def serve(args: Optional[Sequence[str]] = None) -> None:
     serve_from_checkpoint(ckpt_path, cfg)
 
 
+def resume(args: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl_tpu resume run_dir=<logs/runs/.../version_N> [key=value ...]`
+    — relaunch a preempted/crashed run from its newest complete checkpoint
+    with full state (RNG keys, global step, replay buffer). The run's saved
+    config is reloaded and fingerprint-checked against the resume manifest
+    (resilience/resume.py); `force=true` overrides a mismatch."""
+    argv = list(args if args is not None else sys.argv[1:])
+    import sheeprl_tpu  # ensure registries are populated
+    from .resilience.resume import parse_resume_argv, resume_run
+    from .utils.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    run_dir, rest, force = parse_resume_argv(argv)
+    resume_run(run_dir, rest, force=force)
+
+
 def registration(args: Optional[Sequence[str]] = None) -> None:
     """`sheeprl_tpu registration checkpoint_path=... [backend=mlflow]` —
     register a trained model, split per the algo's MODELS_TO_REGISTER
@@ -303,9 +335,9 @@ def available_agents() -> None:
 
 
 def main() -> None:
-    """Console dispatcher: `python -m sheeprl_tpu <run|eval|serve|registration|agents> ...`"""
+    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|registration|agents> ...`"""
     argv = sys.argv[1:]
-    if argv and argv[0] in ("run", "eval", "evaluation", "serve", "registration", "agents"):
+    if argv and argv[0] in ("run", "eval", "evaluation", "resume", "serve", "registration", "agents"):
         cmd, rest = argv[0], argv[1:]
     else:
         cmd, rest = "run", argv
@@ -313,6 +345,8 @@ def main() -> None:
         run(rest)
     elif cmd in ("eval", "evaluation"):
         evaluation(rest)
+    elif cmd == "resume":
+        resume(rest)
     elif cmd == "serve":
         serve(rest)
     elif cmd == "registration":

@@ -41,6 +41,15 @@ class ReplayBuffer:
     """Circular dict buffer of shape [buffer_size, n_envs, ...] per key."""
 
     batch_axis: int = 1
+    # Checkpoint memmap fast path (resilience subsystem): when True and the
+    # buffer is disk-backed, `checkpoint_state_dict` returns a *reference*
+    # to the flushed memmap files instead of a full in-memory copy — the
+    # train thread pays a flush, not a multi-GB copy+pickle. The resulting
+    # checkpoint is only resumable where the run dir's memmap files survive
+    # (the preemption-resume scenario); the CLI sets this from
+    # ``buffer.memmap_fast_resume`` per run (class-level switch, same
+    # pattern as MetricAggregator.disabled).
+    memmap_fast_resume: bool = False
 
     def __init__(
         self,
@@ -254,13 +263,88 @@ class ReplayBuffer:
         post-resume head as one continuous trajectory (reference
         CheckpointCallback._ckpt_rb, sheeprl/utils/callback.py:87-121).
         Non-mutating: the surgery happens on the copied state, the live
-        buffer keeps its true flags."""
+        buffer keeps its true flags.
+
+        With the memmap fast path active (`memmap_fast_resume` + disk-backed
+        storage) the returned dict references the flushed memmap files
+        instead of copying them; the truncation surgery is deferred to
+        `load_state_dict` so the live files stay untouched."""
+        if self.memmap_fast_resume and self._memmap and self._all_memmap():
+            self.flush()
+            # ownership moves to the checkpoint: an owned MemmapArray unlinks
+            # its file on gc, which would destroy the referenced data the
+            # moment the (gracefully drained) run returns
+            for v in self._buf.values():
+                v.has_ownership = False
+            return {
+                "__memmap_ref__": 1,
+                "keys": {
+                    k: {
+                        "filename": str(v.filename),
+                        "shape": tuple(int(s) for s in v.shape),
+                        "dtype": str(np.dtype(v.dtype)),
+                    }
+                    for k, v in self._buf.items()
+                },
+                "pos": self._pos,
+                "full": self._full,
+                "rng": self._rng.bit_generator.state,
+                "truncate_last": bool("truncated" in self._buf and (self._full or self._pos > 0)),
+            }
         state = self.state_dict()
         if "truncated" in state["buffer"] and (self._full or self._pos > 0):
             state["buffer"]["truncated"][(state["pos"] - 1) % self._buffer_size, :] = 1
         return state
 
+    def _all_memmap(self) -> bool:
+        return bool(self._buf) and all(isinstance(v, MemmapArray) for v in self._buf.values())
+
+    def flush(self) -> None:
+        """Flush memmap-backed storage to disk (no-op for in-memory)."""
+        for v in self._buf.values():
+            if isinstance(v, MemmapArray):
+                v.flush()
+
+    def _load_memmap_ref(self, state: Dict[str, Any]) -> "ReplayBuffer":
+        """Rehydrate from a memmap-reference checkpoint: copy each referenced
+        file into this buffer's own storage (never adopt the old run's files
+        — their ownership/cleanup belongs to the old run dir)."""
+        for k, spec in state["keys"].items():
+            shape = tuple(spec["shape"])
+            if shape[:2] != (self._buffer_size, self._n_envs):
+                raise ValueError(
+                    f"memmap-ref checkpoint for '{k}' has shape {shape}, incompatible "
+                    f"with buffer ({self._buffer_size}, {self._n_envs}): resume with the "
+                    "same buffer.size and env.num_envs"
+                )
+            src_path = spec["filename"]
+            if not os.path.exists(src_path):
+                raise FileNotFoundError(
+                    f"memmap fast-path resume needs the original buffer file {src_path} "
+                    "(checkpoint saved with buffer.memmap_fast_resume=True references the "
+                    "run dir's memmap_buffer/ instead of embedding a copy). Restore the "
+                    "run dir or re-train with buffer.memmap_fast_resume=False."
+                )
+            src = np.memmap(src_path, dtype=np.dtype(spec["dtype"]), mode="r", shape=shape)
+            try:
+                self._maybe_create(k, shape[2:], np.dtype(spec["dtype"]))
+                self._buf[k][:] = src
+            finally:
+                del src
+        self._pos = int(state["pos"])
+        self._full = bool(state["full"])
+        self._added = self._pos + (self._buffer_size if self._full else 0)
+        if state.get("rng") is not None:
+            self._rng.bit_generator.state = state["rng"]
+        # deferred truncation surgery (see checkpoint_state_dict): on the
+        # rehydrated copy, never on the referenced live files
+        if state.get("truncate_last") and "truncated" in self._buf:
+            self._buf["truncated"][(self._pos - 1) % self._buffer_size, :] = 1
+        return self
+
     def load_state_dict(self, state: Dict[str, Any]) -> "ReplayBuffer":
+        if state.get("__memmap_ref__"):
+            return self._load_memmap_ref(state)
         for k, v in state["buffer"].items():
             self._maybe_create(k, v.shape[2:], v.dtype)
             self._buf[k][:] = v
@@ -273,8 +357,11 @@ class ReplayBuffer:
 
     @staticmethod
     def from_state_dict(state: Dict[str, Any], **kwargs: Any) -> "ReplayBuffer":
-        any_arr = next(iter(state["buffer"].values()))
-        rb = ReplayBuffer(any_arr.shape[0], any_arr.shape[1], **kwargs)
+        if state.get("__memmap_ref__"):
+            shape = tuple(next(iter(state["keys"].values()))["shape"])
+        else:
+            shape = next(iter(state["buffer"].values())).shape
+        rb = ReplayBuffer(shape[0], shape[1], **kwargs)
         return rb.load_state_dict(state)
 
 

@@ -93,6 +93,12 @@ class MemmapArray(np.lib.mixins.NDArrayOperatorsMixin):
     def __len__(self) -> int:
         return self._shape[0]
 
+    def flush(self) -> None:
+        """Push dirty pages to the backing file (durability point for the
+        checkpoint memmap fast path)."""
+        if self._array is not None:
+            self._array.flush()
+
     def __repr__(self) -> str:
         return f"MemmapArray(shape={self._shape}, dtype={self._dtype}, file={self._filename})"
 

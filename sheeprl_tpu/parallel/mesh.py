@@ -42,6 +42,24 @@ _PRECISION_POLICIES = {
 }
 
 
+def distributed_is_initialized() -> bool:
+    """`jax.distributed.is_initialized` is a recent addition; on versions
+    that predate it (e.g. 0.4.3x) fall back to probing the internal client
+    handle. The public probe is preferred so test topologies can patch it."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if callable(probe):
+        try:
+            return bool(probe())
+        except Exception:
+            return False
+    try:
+        from jax._src import distributed as _distributed_internal
+
+        return getattr(_distributed_internal.global_state, "client", None) is not None
+    except Exception:
+        return False
+
+
 @dataclass
 class Precision:
     name: str
@@ -72,7 +90,7 @@ class Distributed:
         del strategy  # parity knob; sharding subsumes DDP/single-device
         # Multi-host initialization (DCN): driven by standard JAX env vars /
         # TPU metadata; only attempt when explicitly configured.
-        if num_nodes > 1 and not jax.distributed.is_initialized():
+        if num_nodes > 1 and not distributed_is_initialized():
             jax.distributed.initialize()
 
         if accelerator in ("auto", None):

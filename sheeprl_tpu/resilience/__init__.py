@@ -1,0 +1,36 @@
+"""Resilience subsystem: preemption-safe training on preemptible fleets.
+
+Production TPU fleets are preemptible (Podracer, arxiv 2104.06272; RLAX,
+arxiv 2512.06392): a SIGTERM can land mid-run with a short grace window, VMs
+stall, envs crash transiently. This package turns "a run" into "a run that
+survives the fleet":
+
+* `preemption.PreemptionGuard` — catches SIGTERM/SIGINT (plus a pluggable
+  maintenance-event poller) and raises a cooperative stop at step boundaries
+  within a grace deadline, triggering a final checkpoint before exit.
+* `ckpt_async.AsyncCheckpointWriter` — atomic checkpoint writes on a
+  background thread with bounded in-flight writes; the train step only pays
+  the device→host snapshot.
+* `supervisor.with_retries` / `supervisor.HeartbeatWatchdog` — jittered
+  exponential backoff for transient errors, and a stalled-progress watchdog
+  that dumps a profiler trace and can convert a dead loop into
+  checkpoint-and-exit.
+* `resume` — full-state resume (RNG keys, global step, replay buffer via the
+  memmap fast path) behind a fingerprint-checked manifest, exposed as
+  `sheeprl_tpu resume run_dir=...`.
+* `guard.RunGuard` — the facade every train loop wires in: one object that
+  owns the wall-clock stopper, the preemption guard, the watchdog and the
+  (async) checkpoint writer.
+"""
+from .ckpt_async import AsyncCheckpointWriter
+from .guard import RunGuard
+from .preemption import PreemptionGuard
+from .supervisor import HeartbeatWatchdog, with_retries
+
+__all__ = [
+    "AsyncCheckpointWriter",
+    "HeartbeatWatchdog",
+    "PreemptionGuard",
+    "RunGuard",
+    "with_retries",
+]

@@ -1,0 +1,190 @@
+"""Asynchronous checkpoint writing.
+
+A synchronous `CheckpointManager.save` blocks the train step for the whole
+device→host fetch *and* the pickle + fsync + rename of a payload that can be
+gigabytes (optimizer moments, replay buffer). The `AsyncCheckpointWriter`
+splits that cost: the caller thread only pays the device→host snapshot
+(`CheckpointManager.to_host_payload` — which can contain cross-host
+collectives and therefore MUST run on the calling thread of every process),
+then hands the host payload to a background writer thread that does the
+atomic tmp → fsync → rename write. In-flight writes are bounded
+(`max_in_flight`): when the writer falls behind, `save` blocks until a slot
+frees instead of queueing unbounded host copies.
+
+Every save emits a `ckpt_async` telemetry event with `block_ms` (time the
+train thread was blocked) and, once the write lands, `write_ms`/`bytes` —
+the JSONL stream the acceptance timing test reads.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..utils.checkpoint import CheckpointManager
+
+
+class AsyncCheckpointWriter:
+    """Drop-in for `CheckpointManager.save` with background writes.
+
+    ``sync=True`` degrades to inline writes (same events, ``mode="sync"``) —
+    the uniform path `RunGuard` uses when async checkpointing is disabled,
+    so resume manifests (`on_write`) behave identically either way.
+    """
+
+    def __init__(
+        self,
+        manager: CheckpointManager,
+        max_in_flight: int = 1,
+        telem: Any = None,
+        on_write: Optional[Callable[[int, str], None]] = None,
+        sync: bool = False,
+    ):
+        self.manager = manager
+        self.telem = telem
+        self.on_write = on_write
+        self.sync = bool(sync)
+        self.last_saved_step: Optional[int] = None  # last step handed to save()
+        self.last_written_step: Optional[int] = None  # last step durably on disk
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(max_in_flight)))
+        self._pending = 0
+        self._cv = threading.Condition()
+        self._worker: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- properties mirrored from the manager ------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self.manager.enabled
+
+    @property
+    def dir(self):
+        return self.manager.dir
+
+    def list_checkpoints(self):
+        return self.manager.list_checkpoints()
+
+    # -- events ------------------------------------------------------------
+    def _emit(self, rec: Dict[str, Any]) -> None:
+        if self.telem is not None:
+            try:
+                self.telem.emit(rec)
+            except Exception:
+                pass
+
+    # -- the write path ----------------------------------------------------
+    def save(self, step: int, state: Dict[str, Any]) -> Optional[str]:
+        """Snapshot `state` to host and schedule the durable write.
+
+        Returns the path the checkpoint will land at (None on non-writer
+        ranks). The caller thread blocks only for the host snapshot plus any
+        wait for an in-flight slot.
+        """
+        t0 = time.perf_counter()
+        # device→host conversion runs on EVERY process (it can contain an
+        # all-gather collective) and on the CALLING thread (collectives must
+        # not race the train step) — exactly like the sync path.
+        payload = self.manager.to_host_payload(state)
+        if not self.manager.enabled:
+            return None
+        step = int(step)
+        if self.sync:
+            path = self.manager.write_payload(step, payload)
+            block_ms = (time.perf_counter() - t0) * 1000.0
+            self.last_saved_step = step
+            if path:
+                self._finish(step, path, block_ms=block_ms, write_ms=block_ms, mode="sync")
+            return path
+
+        self._ensure_worker()
+        with self._cv:
+            self._pending += 1
+        self._q.put((step, payload))  # blocks when max_in_flight writes queued
+        block_ms = (time.perf_counter() - t0) * 1000.0
+        self.last_saved_step = step
+        self._emit(
+            {
+                "event": "ckpt_async",
+                "action": "enqueued",
+                "step": step,
+                "block_ms": round(block_ms, 3),
+                "in_flight": self._pending,
+                "mode": "async",
+            }
+        )
+        return str(self.manager.dir / f"ckpt_{step}.ckpt")
+
+    def _finish(self, step: int, path: str, block_ms: float, write_ms: float, mode: str) -> None:
+        self.last_written_step = step
+        try:
+            nbytes = os.path.getsize(path)
+        except OSError:
+            nbytes = 0
+        if self.on_write is not None:
+            try:
+                self.on_write(step, path)
+            except Exception as err:
+                print(f"[resilience] checkpoint on_write hook failed: {err}", file=sys.stderr)
+        self._emit(
+            {
+                "event": "ckpt_async",
+                "action": "written",
+                "step": step,
+                "block_ms": round(block_ms, 3),
+                "write_ms": round(write_ms, 3),
+                "bytes": nbytes,
+                "path": path,
+                "mode": mode,
+            }
+        )
+
+    # -- the background writer ---------------------------------------------
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._run, name="ckpt-async-writer", daemon=True
+            )
+            self._worker.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            step, payload = item
+            try:
+                t0 = time.perf_counter()
+                path = self.manager.write_payload(step, payload)
+                write_ms = (time.perf_counter() - t0) * 1000.0
+                if path:
+                    self._finish(step, path, block_ms=0.0, write_ms=write_ms, mode="async")
+            except Exception as err:  # a failed write must not kill training
+                print(f"[resilience] async checkpoint write failed: {err}", file=sys.stderr)
+                self._emit(
+                    {"event": "ckpt_async", "action": "failed", "step": int(step), "mode": "async"}
+                )
+            finally:
+                with self._cv:
+                    self._pending -= 1
+                    self._cv.notify_all()
+
+    # -- lifecycle ---------------------------------------------------------
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Block until every enqueued write has landed (True) or `timeout`
+        elapsed (False)."""
+        with self._cv:
+            return self._cv.wait_for(lambda: self._pending == 0, timeout=timeout)
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Flush pending writes and stop the worker."""
+        if self._closed:
+            return True
+        self._closed = True
+        drained = self.flush(timeout=timeout)
+        if self._worker is not None and self._worker.is_alive():
+            self._q.put(None)
+            self._worker.join(timeout=5.0)
+        return drained

@@ -1,0 +1,235 @@
+"""`RunGuard` — the one resilience object every train loop wires in.
+
+It owns, behind a three-line integration (`setup` after the checkpoint
+manager, `stop_reached` at the loop's step boundary, `close` after the
+loop):
+
+* the wall-clock stopper (previously `WallClockStopper` + `wall_cap_reached`
+  inline in every loop),
+* the `PreemptionGuard` (SIGTERM/SIGINT + maintenance poller) with the
+  final-checkpoint-within-grace drain,
+* the optional `HeartbeatWatchdog`,
+* the `AsyncCheckpointWriter` wrap over the loop's `CheckpointManager`
+  (exposed as `guard.ckpt`, a drop-in for the manager), and
+* the resume manifest refresh after every successful write.
+
+Like `WallClockStopper`, preemption drain is single-host only: rank-local
+signals cannot coordinate a multi-host stop, and a rank-0-only final save
+would deadlock the collective host conversion on the other hosts. Multi-host
+runs get a stderr note and rely on the periodic checkpoint cadence.
+"""
+from __future__ import annotations
+
+import queue
+import sys
+from typing import Any, Callable, Dict, Optional
+
+from ..utils import run_info
+from ..utils.utils import WallClockStopper, wall_cap_reached
+from .ckpt_async import AsyncCheckpointWriter
+from .preemption import PreemptionGuard, clear_preemption
+from .supervisor import HeartbeatWatchdog
+
+
+class RunGuard:
+    """Facade over preemption / wall-cap / watchdog / async checkpointing."""
+
+    def __init__(
+        self,
+        cfg: Any,
+        ckpt: AsyncCheckpointWriter,
+        wall: WallClockStopper,
+        preempt: Optional[PreemptionGuard] = None,
+        watchdog: Optional[HeartbeatWatchdog] = None,
+        telem: Any = None,
+    ):
+        self.cfg = cfg
+        self.ckpt = ckpt
+        self.wall = wall
+        self.preempt = preempt
+        self.watchdog = watchdog
+        self.telem = telem
+        self._preempt_logged = False
+        self._closed = False
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def setup(cls, cfg: Any, ckpt_manager: Any, telem: Any = None, log_dir: Optional[str] = None) -> "RunGuard":
+        sel = cfg.select if hasattr(cfg, "select") else (lambda p, d=None: d)
+
+        on_write = None
+        if log_dir:
+            from .resume import write_manifest
+
+            on_write = lambda step, path: write_manifest(log_dir, cfg, step, path)  # noqa: E731
+
+        writer = AsyncCheckpointWriter(
+            ckpt_manager,
+            max_in_flight=int(sel("resilience.async_checkpoint.max_in_flight", 1) or 1),
+            telem=telem,
+            on_write=on_write,
+            sync=not bool(sel("resilience.async_checkpoint.enabled", True)),
+        )
+
+        preempt: Optional[PreemptionGuard] = None
+        if bool(sel("resilience.preemption.enabled", True)):
+            import jax
+
+            if jax.process_count() > 1:
+                print(
+                    "[resilience] preemption drain disabled: rank-local signals cannot "
+                    "coordinate a multi-host stop (rely on checkpoint.every)",
+                    file=sys.stderr,
+                )
+            else:
+                # NOTE: a pending process-wide flag is deliberately NOT
+                # cleared here — a SIGTERM that landed between two in-process
+                # runs (supervise restarts) must drain the next run too. The
+                # guard that *observes* a preemption clears it in close().
+                poller = None
+                poller_cfg = sel("resilience.preemption.poller")
+                if poller_cfg:
+                    from ..config import instantiate
+
+                    poller = instantiate(poller_cfg)
+                preempt = PreemptionGuard(
+                    signals=tuple(sel("resilience.preemption.signals", ("SIGTERM", "SIGINT"))),
+                    grace_s=float(sel("resilience.preemption.grace_s", 30.0)),
+                    poller=poller,
+                    poll_every_s=float(sel("resilience.preemption.poll_every_s", 5.0)),
+                ).install()
+
+        watchdog: Optional[HeartbeatWatchdog] = None
+        if bool(sel("resilience.watchdog.enabled", False)):
+            watchdog = HeartbeatWatchdog(
+                stall_s=float(sel("resilience.watchdog.stall_s", 300.0)),
+                action=str(sel("resilience.watchdog.action", "none")),
+                telem=telem,
+                trace_dir=(f"{log_dir}/xprof_watchdog" if log_dir else None),
+                trace_s=float(sel("resilience.watchdog.trace_s", 3.0)),
+            ).start()
+
+        guard = cls(cfg, writer, WallClockStopper(cfg), preempt, watchdog, telem)
+        if telem is not None and sel("checkpoint.resume_from"):
+            guard._emit(
+                {
+                    "event": "resume",
+                    "step": 0,
+                    "checkpoint": str(sel("checkpoint.resume_from")),
+                }
+            )
+        return guard
+
+    # -- events ------------------------------------------------------------
+    def _emit(self, rec: Dict[str, Any]) -> None:
+        if self.telem is not None:
+            try:
+                self.telem.emit(rec)
+            except Exception:
+                pass
+
+    @property
+    def preempted(self) -> bool:
+        return self.preempt is not None and self.preempt.requested
+
+    # -- the step-boundary check -------------------------------------------
+    def stop_reached(
+        self,
+        policy_step: int,
+        total_steps: int,
+        state_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        save: bool = True,
+    ) -> bool:
+        """Call once per loop iteration (where `wall_cap_reached` used to
+        be). Returns True when the loop must break — preemption requested or
+        wall budget spent — after writing the final checkpoint."""
+        if self.watchdog is not None:
+            self.watchdog.beat(policy_step)
+        if self.preempt is not None and self.preempt.poll():
+            if not self._preempt_logged:
+                self._preempt_logged = True
+                self._emit(
+                    {
+                        "event": "preempt",
+                        "step": int(policy_step),
+                        "action": "requested",
+                        "signal": str(self.preempt.signal_name),
+                        "grace_s": self.preempt.grace_s,
+                    }
+                )
+            if save and state_fn is not None:
+                self._final_save(policy_step, state_fn)
+            run_info.last_run.update(
+                policy_step=int(policy_step), total_steps=int(total_steps), preempted=True
+            )
+            return True
+        return wall_cap_reached(
+            self.wall, policy_step, total_steps, self.ckpt, state_fn, self.cfg, save=save
+        )
+
+    def _final_save(self, policy_step: int, state_fn: Callable[[], Dict[str, Any]]) -> None:
+        """The preemption drain: one last checkpoint, flushed to disk inside
+        the remaining grace budget (unconditional — unlike the wall cap this
+        state is about to be lost with the machine)."""
+        deadline = self.preempt.deadline_remaining() if self.preempt else float("inf")
+        if self.ckpt.last_saved_step == int(policy_step):
+            # a cadence save already targeted this exact step — but only
+            # trust it once the background write has LANDED; a failed write
+            # must not satisfy the drain (last_written_step tracks success)
+            self.ckpt.flush(timeout=None if deadline == float("inf") else max(1.0, deadline))
+            if self.ckpt.last_written_step == int(policy_step) or not self.ckpt.enabled:
+                return
+        try:
+            self.ckpt.save(policy_step, state_fn())
+        except Exception as err:
+            print(f"[resilience] final preemption checkpoint failed: {err}", file=sys.stderr)
+            return
+        deadline = self.preempt.deadline_remaining() if self.preempt else float("inf")
+        landed = self.ckpt.flush(timeout=None if deadline == float("inf") else max(1.0, deadline))
+        self._emit(
+            {
+                "event": "preempt",
+                "step": int(policy_step),
+                "action": "checkpointed" if landed else "flush_timeout",
+            }
+        )
+
+    # -- preemption-aware queue wait (decoupled loops) ---------------------
+    def wait(self, q: "queue.Queue", poll_s: float = 0.5) -> Any:
+        """`q.get()` that wakes up on preemption: a trainer parked on a dead
+        player's queue (or vice versa) drains instead of hanging forever.
+        Returns the item, or None when preemption was requested first."""
+        while True:
+            try:
+                return q.get(timeout=poll_s)
+            except queue.Empty:
+                if self.preempted:
+                    return None
+
+    # -- shutdown ----------------------------------------------------------
+    def close(self, policy_step: int = 0, state_fn: Optional[Callable[[], Dict[str, Any]]] = None) -> None:
+        """Call after the loop (before `telem.close`): writes the final
+        preemption checkpoint if the loop broke out without one, flushes the
+        async writer, and tears down watchdog + signal handlers."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            if self.preempted and state_fn is not None:
+                try:
+                    self._final_save(policy_step, state_fn)
+                except Exception as err:  # state_fn can be loop-local-state dependent
+                    print(f"[resilience] close-time checkpoint skipped: {err}", file=sys.stderr)
+        finally:
+            deadline = self.preempt.deadline_remaining() if self.preempted and self.preempt else float("inf")
+            self.ckpt.close(timeout=None if deadline == float("inf") else max(1.0, deadline))
+            if self.watchdog is not None:
+                self.watchdog.stop()
+            if self.preempt is not None:
+                if self.preempt.requested:
+                    # this run observed and drained the request: consume the
+                    # process-wide flag so the next in-process run (tests,
+                    # supervise restart, resume) starts clean — a signal
+                    # arriving AFTER this point re-raises it for that run
+                    clear_preemption()
+                self.preempt.uninstall()

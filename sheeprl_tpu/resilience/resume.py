@@ -1,0 +1,199 @@
+"""Full-state resume behind a fingerprint-checked manifest.
+
+A checkpoint already carries the full training state (params, optimizer
+moments, RNG keys as uint32 key data, counters, replay buffer — via the
+memmap fast path when the buffer is disk-backed, see
+`data.buffers.ReplayBuffer.checkpoint_state_dict`). What was missing is the
+*supervisor side*: after a preemption nothing re-invoked
+``checkpoint.resume_from``. This module closes the loop:
+
+* every successful checkpoint write refreshes ``resume_manifest.json`` in
+  the run's log dir (step, relative checkpoint path, config fingerprint);
+* ``sheeprl_tpu resume run_dir=<logs/runs/.../version_N>`` reloads the run's
+  saved config, rejects a config whose *fingerprint* (the experiment-defining
+  subtree: algo/env/buffer/distribution/seed, minus the reference-protected
+  `total_steps`/`learning_starts`) no longer matches the manifest, wires the
+  newest checkpoint into ``checkpoint.resume_from`` and relaunches.
+
+The fingerprint check is what makes auto-resume safe on a fleet: a restarted
+job that composed a *different* experiment (code push changed a default,
+wrong overrides) fails loudly instead of silently polluting the old run.
+`force=True` (CLI: ``force=true``) overrides the check for deliberate
+surgery.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..config import Config, load_config_file
+
+MANIFEST_NAME = "resume_manifest.json"
+MANIFEST_SCHEMA = 1
+
+# The experiment-defining config subtree. Hardware (fabric), logging
+# (metric), output naming and the checkpoint/resilience knobs themselves are
+# deliberately NOT part of the identity: resuming on a different device
+# count or with a different log cadence is legitimate.
+_FINGERPRINT_GROUPS = ("algo", "env", "buffer", "distribution", "seed")
+# Reference cli.py:49-57 protects these across resume; users may change them.
+_FINGERPRINT_DROP_PATHS = (("algo", "total_steps"), ("algo", "learning_starts"))
+
+
+def config_fingerprint(cfg: Any) -> str:
+    """Stable hash of the experiment-defining config subtree."""
+    as_dict = cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg)
+    picked: Dict[str, Any] = {k: as_dict.get(k) for k in _FINGERPRINT_GROUPS}
+    for group, key in _FINGERPRINT_DROP_PATHS:
+        node = picked.get(group)
+        if isinstance(node, dict) and key in node:
+            node = dict(node)
+            node.pop(key, None)
+            picked[group] = node
+    canon = json.dumps(picked, sort_keys=True, default=str)
+    return hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
+# -- manifest ---------------------------------------------------------------
+def write_manifest(log_dir: str, cfg: Any, step: int, ckpt_path: str) -> str:
+    """Atomically refresh `<log_dir>/resume_manifest.json` after a
+    checkpoint write (RunGuard wires this as the writer's `on_write`)."""
+    log_dir_p = Path(log_dir)
+    try:
+        rel = str(Path(ckpt_path).relative_to(log_dir_p))
+    except ValueError:
+        rel = str(ckpt_path)
+    manifest = {
+        "schema": MANIFEST_SCHEMA,
+        "fingerprint": config_fingerprint(cfg),
+        "algo": cfg.select("algo.name") if hasattr(cfg, "select") else None,
+        "env_id": cfg.select("env.id") if hasattr(cfg, "select") else None,
+        "step": int(step),
+        "checkpoint": rel,
+        "updated_at": round(time.time(), 3),
+    }
+    path = log_dir_p / MANIFEST_NAME
+    tmp = path.with_suffix(".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return str(path)
+
+
+def read_manifest(log_dir: os.PathLike) -> Optional[Dict[str, Any]]:
+    path = Path(log_dir) / MANIFEST_NAME
+    if not path.is_file():
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+# -- locating the run -------------------------------------------------------
+def resolve_version_dir(run_dir: os.PathLike) -> Path:
+    """Accept either a `version_N` log dir (has config.yaml) or the run base
+    dir above it (pick the newest version that has a saved config)."""
+    run_dir_p = Path(run_dir)
+    if (run_dir_p / "config.yaml").is_file():
+        return run_dir_p
+    versions = sorted(
+        (p for p in run_dir_p.glob("version_*") if (p / "config.yaml").is_file()),
+        key=lambda p: int(p.name.split("_")[1]) if p.name.split("_")[1].isdigit() else -1,
+    )
+    if not versions:
+        raise FileNotFoundError(
+            f"Cannot resume: no saved config.yaml under {run_dir_p} "
+            "(expected a run log dir like logs/runs/<root>/<run>/version_0)"
+        )
+    return versions[-1]
+
+
+def find_latest_checkpoint(log_dir: Path, manifest: Optional[Dict[str, Any]] = None) -> Optional[Path]:
+    """Newest complete checkpoint: prefer the manifest pointer, fall back to
+    scanning `<log_dir>/checkpoint/` (manifest lost or pre-resilience run).
+    The scan is `CheckpointManager.list_checkpoints` — one name filter and
+    step ordering shared with pruning, not a parallel re-implementation."""
+    if manifest and manifest.get("checkpoint"):
+        cand = log_dir / str(manifest["checkpoint"])
+        if cand.is_file():
+            return cand
+    from ..utils.checkpoint import CheckpointManager
+
+    ckpts = CheckpointManager(str(log_dir), enabled=False).list_checkpoints()
+    return ckpts[-1] if ckpts else None
+
+
+# -- the resume entrypoint --------------------------------------------------
+def build_resume_config(
+    run_dir: os.PathLike, overrides: Sequence[str] = (), force: bool = False
+) -> Tuple[Config, Path]:
+    """Load the run's saved config + newest checkpoint, apply CLI overrides,
+    and enforce the fingerprint check. Returns (cfg, ckpt_path) with
+    ``checkpoint.resume_from`` already wired."""
+    import yaml
+
+    log_dir = resolve_version_dir(run_dir)
+    cfg = load_config_file(log_dir / "config.yaml")
+    manifest = read_manifest(log_dir)
+    ckpt = find_latest_checkpoint(log_dir, manifest)
+    if ckpt is None:
+        raise FileNotFoundError(
+            f"Cannot resume {log_dir}: no complete checkpoint found under "
+            f"{log_dir / 'checkpoint'} (the run may have died before its first save)"
+        )
+    for ov in overrides:
+        if "=" not in ov:
+            raise ValueError(f"Malformed override '{ov}' (expected key=value)")
+        k, _, v = ov.partition("=")
+        cfg.set_path(k.strip(), yaml.safe_load(v))
+    if manifest and manifest.get("fingerprint"):
+        now = config_fingerprint(cfg)
+        if now != manifest["fingerprint"] and not force:
+            raise ValueError(
+                f"Resume fingerprint mismatch for {log_dir}: the composed config hashes "
+                f"to {now} but the manifest recorded {manifest['fingerprint']}. The "
+                "experiment-defining config (algo/env/buffer/distribution/seed) changed "
+                "since the checkpoint was written — resume would silently pollute the "
+                "run. Pass force=true to override deliberately."
+            )
+    cfg.set_path("checkpoint.resume_from", str(ckpt))
+    return cfg, ckpt
+
+
+def resume_run(run_dir: os.PathLike, overrides: Sequence[str] = (), force: bool = False) -> None:
+    """`sheeprl_tpu resume run_dir=... [key=value ...]` — relaunch a run from
+    its newest checkpoint with full state (config merge, fingerprint check,
+    RNG/step/buffer restore happen in the loop's resume path)."""
+    from ..cli import check_configs, run_algorithm
+
+    cfg, ckpt = build_resume_config(run_dir, overrides, force=force)
+    check_configs(cfg)
+    print(f"[resilience] resuming from {ckpt}", flush=True)
+    run_algorithm(cfg)
+
+
+def parse_resume_argv(argv: Sequence[str]) -> Tuple[str, List[str], bool]:
+    """Split `run_dir=...` and the optional `force=...` out of a resume argv."""
+    import yaml
+
+    run_dir: Optional[str] = None
+    force = False
+    rest: List[str] = []
+    for a in argv:
+        if a.startswith("run_dir="):
+            run_dir = a.split("=", 1)[1]
+        elif a.startswith("force="):
+            force = bool(yaml.safe_load(a.split("=", 1)[1]))
+        else:
+            rest.append(a)
+    if run_dir is None:
+        raise ValueError("resume requires `run_dir=<logs/runs/.../version_N>`")
+    return run_dir, rest, force

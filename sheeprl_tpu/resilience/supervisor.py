@@ -1,0 +1,279 @@
+"""Run supervision: retries with jittered backoff + a stalled-progress watchdog.
+
+`with_retries` wraps transient operations (env construction over flaky
+sockets, device initialization on a busy fleet) in jittered exponential
+backoff — the Podracer-style answer to "the first connect sometimes loses".
+
+`HeartbeatWatchdog` watches *step progress*: every `RunGuard.stop_reached`
+call beats it with the current policy step. If no step advance happens for
+`stall_s` seconds the watchdog fires: it emits a `watchdog` event, dumps a
+short profiler trace through the telemetry facade (so the stall is
+diagnosable post-mortem) and optionally escalates — `action="preempt"`
+raises the cooperative preemption flag, which converts a wedged loop (or a
+dead player/trainer thread parked on a queue) into checkpoint-and-exit via
+the same drain path a SIGTERM takes.
+
+`supervise` is the run-level retry loop behind
+``resilience.supervisor.attempts``: it re-invokes a whole training
+entrypoint after a transient crash, rewiring ``checkpoint.resume_from`` to
+the newest checkpoint the previous attempt left behind (restart-with-backoff
+that loses at most one checkpoint interval).
+"""
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Iterable, Optional, Tuple, Type
+
+from .preemption import PreemptionGuard
+
+
+def _emit(telem: Any, rec: dict) -> None:
+    if telem is not None:
+        try:
+            telem.emit(rec)
+        except Exception:
+            pass
+
+
+def with_retries(
+    fn: Callable[[], Any],
+    op: str = "op",
+    attempts: int = 3,
+    backoff_s: float = 1.0,
+    max_backoff_s: float = 30.0,
+    jitter: float = 0.5,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError, ConnectionError, TimeoutError),
+    telem: Any = None,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+) -> Any:
+    """Call `fn()` with up to `attempts` tries and jittered exponential
+    backoff between them. Only exceptions matching `retry_on` are retried —
+    configuration errors (ValueError & co) surface immediately."""
+    attempts = max(1, int(attempts))
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except retry_on as err:
+            if attempt >= attempts:
+                raise
+            sleep_s = min(float(max_backoff_s), float(backoff_s) * (2 ** (attempt - 1)))
+            sleep_s *= 1.0 + random.uniform(-jitter, jitter)
+            sleep_s = max(0.0, sleep_s)
+            print(
+                f"[resilience] {op} failed (attempt {attempt}/{attempts}): {err!r}; "
+                f"retrying in {sleep_s:.2f}s",
+                file=sys.stderr,
+                flush=True,
+            )
+            _emit(
+                telem,
+                {
+                    "event": "retry",
+                    "op": str(op),
+                    "attempt": attempt,
+                    "error": repr(err),
+                    "sleep_s": round(sleep_s, 3),
+                },
+            )
+            if on_retry is not None:
+                on_retry(attempt, err)
+            time.sleep(sleep_s)
+
+
+def make_retrying(cfg: Any, telem: Any = None) -> Optional[Callable[..., Any]]:
+    """Build a `with_retries` partial from ``cfg.resilience.retries`` (None
+    when disabled) — the hook `utils.env.vectorize` uses for transient
+    env-construction failures."""
+    sel = cfg.select if hasattr(cfg, "select") else (lambda p, d=None: d)
+    if not bool(sel("resilience.retries.enabled", True)):
+        return None
+    attempts = int(sel("resilience.retries.attempts", 3) or 1)
+    if attempts <= 1:
+        return None
+
+    def run(fn: Callable[[], Any], op: str = "op") -> Any:
+        return with_retries(
+            fn,
+            op=op,
+            attempts=attempts,
+            backoff_s=float(sel("resilience.retries.backoff_s", 1.0)),
+            max_backoff_s=float(sel("resilience.retries.max_backoff_s", 30.0)),
+            jitter=float(sel("resilience.retries.jitter", 0.5)),
+            telem=telem,
+        )
+
+    return run
+
+
+class HeartbeatWatchdog:
+    """Background thread that detects stalled step progress.
+
+    `beat(step)` stamps the clock whenever the step advances; the monitor
+    fires once per stall episode after `stall_s` seconds without advance.
+    """
+
+    def __init__(
+        self,
+        stall_s: float = 300.0,
+        action: str = "none",
+        telem: Any = None,
+        trace_dir: Optional[str] = None,
+        trace_s: float = 3.0,
+        poll_s: float = 1.0,
+        on_stall: Optional[Callable[[int, float], None]] = None,
+    ):
+        self.stall_s = float(stall_s)
+        self.action = str(action)
+        self.telem = telem
+        self.trace_dir = trace_dir
+        self.trace_s = float(trace_s)
+        self.poll_s = float(poll_s)
+        self.on_stall = on_stall
+        self._last_step: Optional[int] = None
+        self._last_t = time.monotonic()
+        self._fired = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HeartbeatWatchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, name="resilience-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def beat(self, step: int) -> None:
+        step = int(step)
+        if step != self._last_step:
+            self._last_step = step
+            self._last_t = time.monotonic()
+            self._fired = False
+
+    # -- monitor -----------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            stalled_s = time.monotonic() - self._last_t
+            if stalled_s < self.stall_s or self._fired:
+                continue
+            self._fired = True
+            step = self._last_step or 0
+            print(
+                f"[resilience] watchdog: no step advance for {stalled_s:.0f}s "
+                f"(last step {step}); action={self.action}",
+                file=sys.stderr,
+                flush=True,
+            )
+            trace_dir = self._dump_trace()
+            rec = {
+                "event": "watchdog",
+                "action": "stall",
+                "step": step,
+                "stalled_s": round(stalled_s, 1),
+            }
+            if trace_dir:
+                rec["trace_dir"] = trace_dir
+            _emit(self.telem, rec)
+            if self.on_stall is not None:
+                try:
+                    self.on_stall(step, stalled_s)
+                except Exception:
+                    pass
+            if self.action == "preempt":
+                # escalate through the cooperative drain path: the loop (or a
+                # guard.wait parked on a dead thread's queue) checkpoints and
+                # exits exactly as it would on SIGTERM
+                PreemptionGuard.trigger("watchdog")
+                _emit(self.telem, {"event": "watchdog", "action": "preempt", "step": step})
+
+    def _dump_trace(self) -> Optional[str]:
+        """Capture a short profiler window so the stall is attributable
+        (device-bound vs host-bound) post-mortem. Best-effort: an active
+        outer trace or an unsupported backend must not break the watchdog."""
+        if not self.trace_dir:
+            return None
+        try:
+            import jax.profiler as prof
+
+            out = os.path.join(self.trace_dir, f"stall_{int(time.time())}")
+            prof.start_trace(out)
+            time.sleep(max(0.1, self.trace_s))
+            prof.stop_trace()
+            return out
+        except Exception:
+            return None
+
+
+def latest_checkpoint_under(base: Path) -> Optional[Path]:
+    """Newest complete checkpoint across every `version_*/` under a run base
+    dir (newest version first, highest step within it; per-version scan is
+    `CheckpointManager.list_checkpoints` — shared with pruning/resume)."""
+    from ..utils.checkpoint import CheckpointManager
+
+    base = Path(base)
+    if not base.is_dir():
+        return None
+    best: Optional[Tuple[int, int, Path]] = None
+    for version_dir in base.glob("version_*"):
+        try:
+            version = int(version_dir.name.split("_")[1])
+        except (IndexError, ValueError):
+            continue
+        ckpts = CheckpointManager(str(version_dir), enabled=False).list_checkpoints()
+        if not ckpts:
+            continue
+        step = int(ckpts[-1].stem.split("_")[1])
+        if best is None or (version, step) > best[:2]:
+            best = (version, step, ckpts[-1])
+    return best[2] if best else None
+
+
+def supervise(
+    run_fn: Callable[[Any], None],
+    cfg: Any,
+    attempts: int = 2,
+    backoff_s: float = 5.0,
+    max_backoff_s: float = 120.0,
+    jitter: float = 0.5,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+) -> None:
+    """Run a training entrypoint with restart-with-backoff + auto-resume.
+
+    Between attempts the newest checkpoint the crashed attempt wrote (under
+    ``logs/runs/<root_dir>/<run_name>``) is wired into
+    ``checkpoint.resume_from``, so a restart continues rather than restarts
+    from scratch. `KeyboardInterrupt` and `SystemExit` always propagate.
+    """
+    attempts = max(1, int(attempts))
+    base = Path(os.getcwd()) / "logs" / "runs" / str(cfg.select("root_dir")) / str(cfg.select("run_name"))
+    for attempt in range(1, attempts + 1):
+        try:
+            run_fn(cfg)
+            return
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except retry_on as err:
+            if attempt >= attempts:
+                raise
+            ckpt = latest_checkpoint_under(base)
+            sleep_s = min(float(max_backoff_s), float(backoff_s) * (2 ** (attempt - 1)))
+            sleep_s *= 1.0 + random.uniform(-jitter, jitter)
+            print(
+                f"[resilience] run attempt {attempt}/{attempts} crashed: {err!r}; "
+                f"restarting in {max(0.0, sleep_s):.1f}s"
+                + (f" from {ckpt}" if ckpt else " from scratch"),
+                file=sys.stderr,
+                flush=True,
+            )
+            if ckpt is not None:
+                cfg.set_path("checkpoint.resume_from", str(ckpt))
+            time.sleep(max(0.0, sleep_s))

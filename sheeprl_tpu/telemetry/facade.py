@@ -142,6 +142,12 @@ class Telemetry:
         if self.jsonl is not None:
             self.jsonl.write(rec)
 
+    def emit(self, rec: Dict[str, Any]) -> None:
+        """Write one schema-validated event to the JSONL stream — the public
+        hook subsystems (resilience, serving) use; safe from any thread
+        (JsonlSink locks) and a no-op when the sink is off/closed."""
+        self._emit(rec)
+
     # -- spans / annotations ----------------------------------------------
     def span(self, name: str) -> Span:
         return Span(name, tracker=self.tracker, enabled=self._span_enabled, annotate=self.enabled)

@@ -104,6 +104,47 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "params_version": (False, _NUM),
         "error": (False, _STR),
     },
+    # cooperative preemption lifecycle (resilience/preemption.py + guard.py)
+    "preempt": {
+        "step": (True, _NUM),
+        "action": (True, _STR),  # requested | checkpointed | flush_timeout
+        "signal": (False, _STR),
+        "grace_s": (False, _NUM),
+    },
+    # async checkpoint writer (resilience/ckpt_async.py): block_ms is the
+    # train-thread cost, write_ms the background durable-write cost — the
+    # pair the acceptance timing test compares against a sync save
+    "ckpt_async": {
+        "action": (True, _STR),  # enqueued | written | failed
+        "step": (True, _NUM),
+        "block_ms": (False, _NUM),
+        "write_ms": (False, _NUM),
+        "bytes": (False, _NUM),
+        "path": (False, _STR),
+        "in_flight": (False, _NUM),
+        "mode": (False, _STR),  # async | sync
+    },
+    # jittered-backoff retry of a transient op (resilience/supervisor.py)
+    "retry": {
+        "op": (True, _STR),
+        "attempt": (True, _NUM),
+        "error": (False, _STR),
+        "sleep_s": (False, _NUM),
+    },
+    # stalled-progress watchdog firings (resilience/supervisor.py)
+    "watchdog": {
+        "action": (True, _STR),  # stall | preempt
+        "step": (False, _NUM),
+        "stalled_s": (False, _NUM),
+        "trace_dir": (False, _STR),
+    },
+    # a run restored from a checkpoint (resilience/guard.py)
+    "resume": {
+        "step": (True, _NUM),
+        "checkpoint": (False, _STR),
+        "run_dir": (False, _STR),
+        "fingerprint": (False, _STR),
+    },
 }
 
 

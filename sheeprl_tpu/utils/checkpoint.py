@@ -78,22 +78,53 @@ class CheckpointManager:
         # host conversion runs on EVERY process, enabled or not: fetching a
         # globally-sharded array is a collective (all-gather), and a rank-0-
         # only fetch would deadlock the other hosts (_fetch_global)
-        payload = _to_host(state)
+        payload = self.to_host_payload(state)
+        return self.write_payload(step, payload)
+
+    def to_host_payload(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        """Device→host snapshot of the state pytree. May contain cross-host
+        collectives: every process must call it, on the thread that owns the
+        train step (the async writer keeps this on the caller thread and
+        only moves `write_payload` to the background)."""
+        return _to_host(state)
+
+    def write_payload(self, step: int, payload: Dict[str, Any]) -> Optional[str]:
+        """Durable atomic write of an already-host payload: pickle to a tmp
+        file, fsync it, rename into place, fsync the directory — after a
+        crash either the old or the new checkpoint exists, never a torn
+        file (and never a rename whose directory entry was lost)."""
         if not self.enabled:
             return None
         path = self.dir / f"ckpt_{step}.ckpt"
         tmp = path.with_suffix(".tmp")
         with open(tmp, "wb") as f:
             pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        self._fsync_dir()
         self._prune()
         return str(path)
+
+    def _fsync_dir(self) -> None:
+        try:
+            fd = os.open(self.dir, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass  # e.g. directories aren't fsync-able on some filesystems
 
     def _prune(self) -> None:
         if not self.keep_last:
             return
         ckpts = self.list_checkpoints()
-        for old in ckpts[: -self.keep_last]:
+        # never delete the newest complete checkpoint, whatever keep_last
+        # says; in-flight `.tmp` files from the async writer are already
+        # excluded by the `.ckpt`-suffix filter in list_checkpoints
+        keep = max(int(self.keep_last), 1)
+        for old in ckpts[:-keep]:
             try:
                 os.unlink(old)
             except OSError:
@@ -102,10 +133,14 @@ class CheckpointManager:
     def list_checkpoints(self) -> List[Path]:
         if not self.dir.is_dir():
             return []
-        return sorted(
-            (p for p in self.dir.iterdir() if p.suffix == ".ckpt"),
-            key=lambda p: int(p.stem.split("_")[1]),
-        )
+        out = []
+        for p in self.dir.iterdir():
+            if p.suffix != ".ckpt":
+                continue
+            stem = p.stem.split("_")
+            if len(stem) == 2 and stem[0] == "ckpt" and stem[1].isdigit():
+                out.append(p)
+        return sorted(out, key=lambda p: int(p.stem.split("_")[1]))
 
     @staticmethod
     def load(path: os.PathLike) -> Dict[str, Any]:

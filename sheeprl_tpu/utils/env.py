@@ -341,6 +341,17 @@ def vectorize(
     # info["final_obs"].
     from gymnasium.vector import AutoresetMode
 
-    if cfg.env.get("sync_env", True):
-        return gym.vector.SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
-    return gym.vector.AsyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    def build():
+        if cfg.env.get("sync_env", True):
+            return gym.vector.SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+        return gym.vector.AsyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+
+    # transient construction failures (sockets/ports/daemons of the heavier
+    # suites) get jittered-backoff retries; config errors surface immediately
+    # (resilience/supervisor.py gates on retryable exception types)
+    from ..resilience.supervisor import make_retrying
+
+    retrying = make_retrying(cfg)
+    if retrying is not None:
+        return retrying(build, op="env_construction")
+    return build()
