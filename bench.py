@@ -47,6 +47,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import random
 import subprocess
 import sys
 import time
@@ -286,28 +287,43 @@ def main() -> None:
         # probe would just burn the whole preflight budget hanging
         forced_cpu = bool(os.environ.get("BENCH_FORCE_CPU"))
         pre = None
+        preflight_attempts = 0
         if not forced_cpu:
-            # the tunnel relay dies and comes back: retry the probe against a
-            # single DEADLINE — attempts and pauses all consume the one
-            # preflight budget, so total wall-clock never exceeds it (the
-            # driver's own timeout is unknown — round 2 died rc=124). A
-            # hung first probe gets the whole window (slow-but-alive links
-            # still pass); retries only happen after FAST failures, which is
-            # exactly the dead-relay connection-refused case.
+            # the tunnel relay dies and comes back — in BOTH failure modes:
+            # fast connection-refused AND a silent hang (BENCH_r05 fell back
+            # after one HUNG attempt burned the whole window). Every attempt
+            # therefore gets its own timeout (budget/retries by default, so
+            # total wall-clock never exceeds the one preflight budget) and a
+            # jittered pause separates attempts, de-synchronizing recoveries
+            # from a relay that restarts on a fixed cadence. Each attempt is
+            # logged; the count lands on the bench record as
+            # `preflight_attempts`, so a fallback is auditable as "N real
+            # attempts failed", never "gave up after one".
             deadline = time.monotonic() + preflight_budget
+            attempt_budget = float(
+                os.environ.get("BENCH_PREFLIGHT_ATTEMPT_S", max(10.0, preflight_budget / retries))
+            )
+            base_pause = float(os.environ.get("BENCH_PREFLIGHT_RETRY_PAUSE_S", 15))
             for attempt in range(1, retries + 1):
                 remaining = deadline - time.monotonic()
                 if remaining <= 1:
                     break
-                pre = _run_subprocess_record(["preflight"], remaining)
+                preflight_attempts = attempt
+                t_att = time.monotonic()
+                pre = _run_subprocess_record(["preflight"], min(remaining, attempt_budget))
                 if pre is not None and pre.get("ok"):
-                    break
-                pause = float(os.environ.get("BENCH_PREFLIGHT_RETRY_PAUSE_S", 15))
-                if attempt < retries and deadline - time.monotonic() > pause:
                     _progress(
-                        f"preflight attempt {attempt}/{retries} failed; "
-                        f"retrying in {pause:.0f}s"
+                        f"preflight attempt {attempt}/{retries} ok",
+                        seconds=round(time.monotonic() - t_att, 2),
                     )
+                    break
+                pause = base_pause * (1.0 + random.random())  # jittered backoff
+                _progress(
+                    f"preflight attempt {attempt}/{retries} failed "
+                    f"after {time.monotonic() - t_att:.1f}s"
+                    + (f"; retrying in {pause:.1f}s" if attempt < retries else "")
+                )
+                if attempt < retries and deadline - time.monotonic() > pause:
                     time.sleep(pause)
         preflight_failed = not forced_cpu and (pre is None or not pre.get("ok"))
         cpu_fallback = preflight_failed or forced_cpu
@@ -336,6 +352,7 @@ def main() -> None:
         os.environ["BENCH_STEP_DEADLINE"] = str(time.time() + step_budget)
         step_rec = _run_subprocess_record(["dv3_step"], step_budget)
         if step_rec is not None:
+            step_rec["preflight_attempts"] = preflight_attempts
             _emit(step_rec)
         e2e_budget = float(os.environ.get("BENCH_E2E_BUDGET_S", 1100))
         e2e_rec = _run_subprocess_record(["dv3"], e2e_budget)
@@ -349,6 +366,7 @@ def main() -> None:
                 "this is a host-CPU measurement of the same end-to-end recipe"
             )
         if e2e_rec is not None:
+            e2e_rec["preflight_attempts"] = preflight_attempts
             if not cpu_fallback and pre is not None:
                 e2e_rec["platform"] = pre.get("platform")
                 e2e_rec["device_kind"] = pre.get("device_kind", "")
@@ -382,6 +400,7 @@ def main() -> None:
                     "value": 0.0,
                     "unit": "env steps/sec",
                     "vs_baseline": 0.0,
+                    "preflight_attempts": preflight_attempts,
                     "error": (
                         "accelerator preflight failed (device client creation hung — "
                         "tunnel down?) and the CPU fallback leg also failed (see stderr)"

@@ -87,18 +87,24 @@ def record() -> dict:
     train = make_train_fn(wm, actor, critic, txs, cfg, False, actions_dim)
 
     rng = np.random.default_rng(0)
-    data = {
-        "rgb": jnp.asarray(rng.integers(0, 255, (seq, batch, 64, 64, 3), np.uint8)),
-        "actions": jnp.asarray(
-            np.eye(N_ACTIONS, dtype=np.float32)[rng.integers(0, N_ACTIONS, (seq, batch))]
-        ),
-        "rewards": jnp.asarray(rng.standard_normal((seq, batch, 1)), jnp.float32),
-        "terminated": jnp.zeros((seq, batch, 1), jnp.float32),
-        "truncated": jnp.zeros((seq, batch, 1), jnp.float32),
-        "is_first": jnp.zeros((seq, batch, 1), jnp.float32),
+    host_data = {
+        "rgb": rng.integers(0, 255, (1, seq, batch, 64, 64, 3)).astype(np.uint8),
+        "actions": np.eye(N_ACTIONS, dtype=np.float32)[rng.integers(0, N_ACTIONS, (1, seq, batch))],
+        "rewards": rng.standard_normal((1, seq, batch, 1)).astype(np.float32),
+        "terminated": np.zeros((1, seq, batch, 1), np.float32),
+        "truncated": np.zeros((1, seq, batch, 1), np.float32),
+        "is_first": np.zeros((1, seq, batch, 1), np.float32),
     }
     sharding = dist.sharding(None, None, "dp")  # train takes [G, T, B, ...]
-    data = {k: jax.device_put(v[None], sharding) for k, v in data.items()}
+
+    def stage_data() -> dict:
+        # a FRESH device batch per call: `train` donates its batch buffers
+        # (exactly like the train loop, whose prefetcher hands out fresh
+        # arrays every burst); the async device_put overlaps the previous
+        # step's compute, same as the loop's staged prefetch
+        return {k: jax.device_put(v, sharding) for k, v in host_data.items()}
+
+    data = stage_data()
 
     from sheeprl_tpu.utils.utils import enable_compilation_cache
 
@@ -140,6 +146,7 @@ def record() -> dict:
         params, opt_states, moments, metrics = train(
             params, opt_states, moments, data, jax.random.split(k, 1)
         )
+        data = stage_data()
     jax.block_until_ready(metrics)
     _phase(f"warmup done in {time.perf_counter() - _t_warm:.1f}s (incl. any compile); probing")
     # one timed step AFTER warmup (compile already paid) classifies the
@@ -152,6 +159,7 @@ def record() -> dict:
     )
     jax.block_until_ready(metrics)
     warm_step_s = time.perf_counter() - _t_probe
+    data = stage_data()
     _phase(f"probe step {warm_step_s:.2f}s; timing")
 
     # time-capped: on a slow link/machine stop early and report SPS over the
@@ -181,6 +189,7 @@ def record() -> dict:
         params, opt_states, moments, metrics = train(
             params, opt_states, moments, data, jax.random.split(k, 1)
         )
+        data = stage_data()  # dispatch overlaps the in-flight step's compute
         reps += 1
         if reps % sync_every == 0 or reps == max_reps:
             jax.block_until_ready(metrics)

@@ -32,6 +32,7 @@ import optax
 from ...config import Config, instantiate
 from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from ...data.device_ring import estimate_row_bytes, make_sequential_prefetcher
+from ...engine import BufferOpSink, OverlapEngine, Packet, RecordingSink
 from ...distributions import (
     BernoulliSafeMode,
     Independent,
@@ -46,6 +47,7 @@ from ...parallel import Distributed
 from ...parallel.mesh import maybe_shard_opt_state
 from ...parallel.placement import make_param_mirror, player_device
 from ...telemetry import Telemetry
+from ...telemetry import xla as _xla
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, patch_restarted_envs, vectorize
 from ...utils.logger import get_log_dir, get_logger
@@ -53,7 +55,7 @@ from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...resilience import RunGuard
 from ...utils import run_info
-from ...utils.utils import Ratio, save_configs
+from ...utils.utils import Ratio, acknowledge_partial_donation, save_configs
 from .agent import Actor, WorldModel, build_agent, compute_stochastic_state, sample_actor_actions
 from .loss import reconstruction_loss
 from .utils import (
@@ -406,13 +408,19 @@ def make_train_fn(
         }
         return params, opt_states, moments, metrics
 
-    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    acknowledge_partial_donation()  # uint8/flag leaves can't alias; expected
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
     def train(params, opt_states, moments, batches, keys):
         """G gradient steps in ONE device call: scan `one_step` over the
         leading axis of `batches` [G, T, B, ...] / `keys` [G] (the reference
         samples n_samples=G at dreamer_v3.py:664-671 then loops in python;
         here the loop is on device, so per-step dispatch overhead vanishes).
-        Returned metrics are [G]-shaped."""
+        Returned metrics are [G]-shaped. `batches` is donated too: the
+        [G, T, B, ...] replay batch is the largest transient HBM buffer of
+        the heaviest model, consumed exactly once — donating it lets XLA
+        reuse that memory for activations (callers must not reuse a batch
+        across calls; the prefetchers hand out fresh arrays every burst)."""
 
         def body(carry, xs):
             params, opt_states, moments = carry
@@ -428,6 +436,9 @@ def make_train_fn(
         return params, opt_states, moments, metrics
 
     return train
+
+
+_PLAYER_TAG = iter(range(1 << 30))  # unique retrace-detector tags per player
 
 
 def make_player(wm: WorldModel, actor: Actor, cfg: Config, actions_dim, is_continuous: bool, num_envs: int):
@@ -451,8 +462,7 @@ def make_player(wm: WorldModel, actor: Actor, cfg: Config, actions_dim, is_conti
         m = mask[:, None]
         return (jnp.where(m, h0, h), jnp.where(m, z0, z), jnp.where(m, a0, a))
 
-    @partial(jax.jit, static_argnames=("greedy",))
-    def step(params, obs, state, key, greedy=False, action_mask=None):
+    def _step(params, obs, state, key, greedy=False, action_mask=None):
         h, z, a = state
         obs = normalize_obs(obs, cnn_keys)
         embedded = wm.apply({"params": params["wm"]}, obs, method=WorldModel.embed)
@@ -475,6 +485,13 @@ def make_player(wm: WorldModel, actor: Actor, cfg: Config, actions_dim, is_conti
             env_actions = jnp.stack([jnp.argmax(x, axis=-1) for x in acts], axis=-1)
         return env_actions, a, (h, z, a), key
 
+    # retrace-accounted (telemetry.xla): the overlap invariant is that the
+    # pinned player step never retraces after warmup — one trace per greedy
+    # variant. The tag is uniqued per make_player call so successive
+    # in-process runs with different shapes don't count against each other.
+    step = partial(jax.jit, static_argnames=("greedy",))(
+        _xla.RETRACE_DETECTOR.wrap(_step, f"dreamer_v3.player_step#{next(_PLAYER_TAG)}")
+    )
     return init_state, step
 
 
@@ -610,144 +627,98 @@ def main(dist: Distributed, cfg: Config) -> None:
     _progress = int(os.environ.get("SHEEPRL_TPU_PROGRESS", "0") or 0)
     _t0 = time.perf_counter()
 
-    while policy_step < total_steps:
-        telem.tick(policy_step)
-        if guard.stop_reached(policy_step, total_steps, _ckpt_state):
-            break
-        if _progress and policy_step % _progress < num_envs:
-            print(
-                f"[progress] step={policy_step} t={time.perf_counter() - _t0:.1f}s",
-                file=sys.stderr,
-                flush=True,
-            )
-        with telem.span("Time/env_interaction_time"):
-            if policy_step <= learning_starts:
-                actions_env = np.stack([action_space.sample() for _ in range(num_envs)])
-                if is_continuous:
-                    actions_np = actions_env.reshape(num_envs, -1).astype(np.float32)
-                else:
-                    oh = []
-                    acts2d = actions_env.reshape(num_envs, -1)
-                    for j, adim in enumerate(actions_dim):
-                        oh.append(np.eye(adim, dtype=np.float32)[acts2d[:, j]])
-                    actions_np = np.concatenate(oh, axis=-1)
+    p_step = policy_step  # player-side env-step counter (== policy_step serially)
+
+    def interact(sink) -> None:
+        """ONE vector env step (the reference train() env block): act from
+        the mirror snapshot and record the replay-row mutations into `sink`
+        — the real buffer serially (no copies), a `RecordingSink` packet
+        under the overlap engine (applied learner-side in order)."""
+        nonlocal obs, player_state, player_key, p_step
+        if p_step <= learning_starts:
+            actions_env = np.stack([action_space.sample() for _ in range(num_envs)])
+            if is_continuous:
+                actions_np = actions_env.reshape(num_envs, -1).astype(np.float32)
             else:
-                host_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
-                env_actions, actions_cat, player_state, player_key = player_step_fn(
-                    mirror.current(), host_obs, player_state, player_key,
-                    action_mask=extract_masks(obs, num_envs),
-                )
-                actions_np = np.asarray(actions_cat)
-                actions_env = np.asarray(env_actions)
-                if is_continuous:
-                    actions_env = actions_env.reshape(num_envs, -1)
-                elif not is_multidiscrete:
-                    actions_env = actions_env.reshape(num_envs)
-
-            step_data["actions"] = actions_np.reshape(1, num_envs, -1)
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
-
-            next_obs, rewards, terminated, truncated, info = envs.step(actions_env)
-            policy_step += num_envs
-            dones = np.logical_or(terminated, truncated)
-
-            for ep_rew, ep_len in episode_stats(info):
-                aggregator.update("Rewards/rew_avg", ep_rew)
-                aggregator.update("Game/ep_len_avg", ep_len)
-
-            # real next obs (final obs for done envs)
-            real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
-            if "final_obs" in info:
-                for i, fo in enumerate(info["final_obs"]):
-                    if fo is not None:
-                        for k in obs_keys:
-                            real_next_obs[k][i] = np.asarray(fo[k])
-
-            for k in obs_keys:
-                step_data[k] = np.asarray(next_obs[k])[np.newaxis]
-            step_data["is_first"] = np.zeros((1, num_envs, 1), np.float32)
-            step_data["terminated"] = np.asarray(terminated, np.float32).reshape(1, num_envs, 1)
-            step_data["truncated"] = np.asarray(truncated, np.float32).reshape(1, num_envs, 1)
-            step_data["rewards"] = clip_rewards_fn(
-                np.asarray(rewards, np.float32).reshape(1, num_envs, 1)
+                oh = []
+                acts2d = actions_env.reshape(num_envs, -1)
+                for j, adim in enumerate(actions_dim):
+                    oh.append(np.eye(adim, dtype=np.float32)[acts2d[:, j]])
+                actions_np = np.concatenate(oh, axis=-1)
+        else:
+            host_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
+            env_actions, actions_cat, player_state, player_key = player_step_fn(
+                mirror.current(), host_obs, player_state, player_key,
+                action_mask=extract_masks(obs, num_envs),
             )
+            actions_np = np.asarray(actions_cat)
+            actions_env = np.asarray(env_actions)
+            if is_continuous:
+                actions_env = actions_env.reshape(num_envs, -1)
+            elif not is_multidiscrete:
+                actions_env = actions_env.reshape(num_envs)
 
-            # in-flight env restart → truncation boundary + fresh recurrent
-            # state (reference dreamer_v3.py:595-608 / patch_restarted_envs)
-            restarted = patch_restarted_envs(info, dones, rb, step_data)
-            if restarted is not None:
-                player_state = player_init(mirror.current(), restarted, player_state)
+        step_data["actions"] = actions_np.reshape(1, num_envs, -1)
+        sink.add(step_data, validate_args=cfg.buffer.validate_args)
 
-            dones_idxes = np.nonzero(dones)[0].tolist()
-            if dones_idxes:
-                # closing row for finished episodes (reference :639-657)
-                reset_data: Dict[str, np.ndarray] = {}
-                for k in obs_keys:
-                    reset_data[k] = real_next_obs[k][dones_idxes][np.newaxis]
-                reset_data["terminated"] = step_data["terminated"][:, dones_idxes]
-                reset_data["truncated"] = step_data["truncated"][:, dones_idxes]
-                reset_data["actions"] = np.zeros((1, len(dones_idxes), act_total), np.float32)
-                reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
-                reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
-                rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
-                # open row for the new episodes
-                step_data["rewards"][:, dones_idxes] = 0
-                step_data["terminated"][:, dones_idxes] = 0
-                step_data["truncated"][:, dones_idxes] = 0
-                step_data["is_first"][:, dones_idxes] = 1
-                mask = np.zeros((num_envs,), bool)
-                mask[dones_idxes] = True
-                player_state = player_init(mirror.current(), mask, player_state)
+        next_obs, rewards, terminated, truncated, info = envs.step(actions_env)
+        p_step += num_envs
+        dones = np.logical_or(terminated, truncated)
 
-            obs = next_obs
+        for ep_rew, ep_len in episode_stats(info):
+            # through the sink: the aggregator is not thread-safe, so under
+            # overlap these ride the packet and land on the learner thread
+            sink.stat("Rewards/rew_avg", ep_rew)
+            sink.stat("Game/ep_len_avg", ep_len)
 
-        if policy_step >= learning_starts:
-            per_rank_gradient_steps = ratio(policy_step / dist.world_size)
-            telem.record_grad_steps(per_rank_gradient_steps)
-            if per_rank_gradient_steps > 0:
-                _trace = os.environ.get("SHEEPRL_TPU_TRACE")
-                with telem.span("Time/train_time"):
-                    _tt = time.perf_counter()
-                    batches = prefetch.take(per_rank_gradient_steps)  # [G, T, B, ...]
-                    _t_take = time.perf_counter()
-                    root_key, sub = jax.random.split(root_key)
-                    _t_split = time.perf_counter()
-                    params, opt_states, moments, metrics = train(
-                        params,
-                        opt_states,
-                        moments,
-                        batches,
-                        jax.random.split(sub, per_rank_gradient_steps),
-                    )
-                    _t_disp = time.perf_counter()
-                # metrics stay on device until log time — no per-step host sync
-                if not MetricAggregator.disabled:
-                    # device refs held until the log-cadence host sync;
-                    # skip entirely when metrics are off (bench legs)
-                    pending_metrics.append(metrics)
-                if _trace:
-                    jax.tree.leaves(params)[0].block_until_ready()
-                    _t_exec = time.perf_counter()
-                mirror.refresh({"wm": params["wm"], "actor": params["actor"]})
-                if _trace:
-                    jax.tree.leaves(mirror._pending or mirror.params)[0].block_until_ready()
-                    _t_done = time.perf_counter()
-                    print(
-                        f"[trace] burst G={per_rank_gradient_steps} take={_t_take - _tt:.3f}"
-                        f" split={_t_split - _t_take:.3f} dispatch={_t_disp - _t_split:.3f}"
-                        f" exec={_t_exec - _t_disp:.3f} refresh={_t_done - _t_exec:.3f}",
-                        file=sys.stderr,
-                        flush=True,
-                    )
-                run_info.mark_steady(policy_step, sync=lambda: jax.block_until_ready(metrics))
-            if policy_step < total_steps:
-                # overlap the next sample + host→HBM transfer with the train
-                # step the device is computing right now
-                _tt = time.perf_counter()
-                prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
-                if per_rank_gradient_steps > 0 and os.environ.get("SHEEPRL_TPU_TRACE"):
-                    print(f"[trace] stage={time.perf_counter() - _tt:.3f}", file=sys.stderr, flush=True)
+        # real next obs (final obs for done envs)
+        real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+        if "final_obs" in info:
+            for i, fo in enumerate(info["final_obs"]):
+                if fo is not None:
+                    for k in obs_keys:
+                        real_next_obs[k][i] = np.asarray(fo[k])
 
+        for k in obs_keys:
+            step_data[k] = np.asarray(next_obs[k])[np.newaxis]
+        step_data["is_first"] = np.zeros((1, num_envs, 1), np.float32)
+        step_data["terminated"] = np.asarray(terminated, np.float32).reshape(1, num_envs, 1)
+        step_data["truncated"] = np.asarray(truncated, np.float32).reshape(1, num_envs, 1)
+        step_data["rewards"] = clip_rewards_fn(
+            np.asarray(rewards, np.float32).reshape(1, num_envs, 1)
+        )
+
+        # in-flight env restart → truncation boundary + fresh recurrent
+        # state (reference dreamer_v3.py:595-608 / patch_restarted_envs)
+        restarted = patch_restarted_envs(info, dones, sink, step_data)
+        if restarted is not None:
+            player_state = player_init(mirror.current(), restarted, player_state)
+
+        dones_idxes = np.nonzero(dones)[0].tolist()
+        if dones_idxes:
+            # closing row for finished episodes (reference :639-657)
+            reset_data: Dict[str, np.ndarray] = {}
+            for k in obs_keys:
+                reset_data[k] = real_next_obs[k][dones_idxes][np.newaxis]
+            reset_data["terminated"] = step_data["terminated"][:, dones_idxes]
+            reset_data["truncated"] = step_data["truncated"][:, dones_idxes]
+            reset_data["actions"] = np.zeros((1, len(dones_idxes), act_total), np.float32)
+            reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
+            reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
+            sink.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            # open row for the new episodes
+            step_data["rewards"][:, dones_idxes] = 0
+            step_data["terminated"][:, dones_idxes] = 0
+            step_data["truncated"][:, dones_idxes] = 0
+            step_data["is_first"][:, dones_idxes] = 1
+            mask = np.zeros((num_envs,), bool)
+            mask[dones_idxes] = True
+            player_state = player_init(mirror.current(), mask, player_state)
+
+        obs = next_obs
+
+    def flush_logs() -> None:
+        nonlocal last_log
         if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
             for m in pending_metrics:  # host-sync deferred to log cadence
                 for k, v in m.items():
@@ -756,11 +727,150 @@ def main(dist: Distributed, cfg: Config) -> None:
             telem.log(policy_step)
             last_log = policy_step
 
+    def maybe_checkpoint() -> None:
+        nonlocal last_checkpoint
         if (
             cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
         ) or cfg.dry_run or policy_step >= total_steps:
             last_checkpoint = policy_step
             ckpt.save(policy_step, _ckpt_state())
+
+    engine = OverlapEngine.setup(
+        cfg, telem, guard, total_steps=total_steps, initial_step=policy_step
+    )
+    if engine.enabled:
+        # ---- overlapped player/learner loop (engine/overlap.py) ----------
+        def play() -> Packet:
+            rec = RecordingSink()
+            with telem.span("Time/env_interaction_time"):
+                interact(rec)
+            return Packet(rec, num_envs)
+
+        engine.start(play)
+        stopped = False
+        while policy_step < total_steps:
+            telem.tick(policy_step)
+            if guard.stop_reached(policy_step, total_steps, None, save=False):
+                stopped = True
+                break
+            packets = engine.take()
+            if not packets:
+                break
+            # ack packets in FIFO order, feeding the Ratio ledger exactly as
+            # the serial loop would (one call per num_envs env steps)
+            gs = []
+            for pkt in packets:
+                pkt.apply(rb, aggregator)
+                policy_step += pkt.env_steps
+                if policy_step >= learning_starts:
+                    g = ratio(policy_step / dist.world_size)
+                    telem.record_grad_steps(g)
+                    gs.append(g)
+            if _progress and policy_step % _progress < num_envs * len(packets):
+                print(
+                    f"[progress] step={policy_step} t={time.perf_counter() - _t0:.1f}s",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            # one train call per owed burst, same [G, ...] shapes as the
+            # serial loop (no new compiled shapes, no retraces); dispatch is
+            # async, so staging the next burst overlaps device execution
+            bursting = False
+            for i, g in enumerate(gs):
+                if g <= 0:
+                    continue
+                with telem.span("Time/train_time"):
+                    bursting = True
+                    batches = prefetch.take(g)  # [G, T, B, ...]
+                    root_key, sub = jax.random.split(root_key)
+                    params, opt_states, moments, metrics = train(
+                        params, opt_states, moments, batches, jax.random.split(sub, g)
+                    )
+                if not MetricAggregator.disabled:
+                    pending_metrics.append(metrics)
+                nxt = next((x for x in gs[i + 1 :] if x > 0), 0)
+                if nxt > 0:
+                    prefetch.stage(nxt)
+            if bursting:
+                mirror.refresh({"wm": params["wm"], "actor": params["actor"]})
+                run_info.mark_steady(policy_step, sync=lambda: jax.block_until_ready(metrics))
+            engine.published()  # release take()'s claim every iteration
+            if policy_step < total_steps:
+                prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
+            flush_logs()
+            maybe_checkpoint()
+        # drain: player stops feeding, queued transitions land in the buffer
+        # so the final checkpoint is consistent (the ratio ledger catches up
+        # at resume time for drained-but-untrained steps)
+        policy_step += engine.shutdown(lambda pkt: pkt.apply(rb, aggregator))
+        if stopped and not guard.preempted and cfg.checkpoint.save_last:
+            ckpt.save(policy_step, _ckpt_state())
+    else:
+        # ---- serial loop (reference semantics) ----------------------------
+        sink = BufferOpSink(rb, aggregator)
+        while policy_step < total_steps:
+            telem.tick(policy_step)
+            if guard.stop_reached(policy_step, total_steps, _ckpt_state):
+                break
+            if _progress and policy_step % _progress < num_envs:
+                print(
+                    f"[progress] step={policy_step} t={time.perf_counter() - _t0:.1f}s",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            with telem.span("Time/env_interaction_time"):
+                interact(sink)
+            policy_step = p_step
+
+            if policy_step >= learning_starts:
+                per_rank_gradient_steps = ratio(policy_step / dist.world_size)
+                telem.record_grad_steps(per_rank_gradient_steps)
+                if per_rank_gradient_steps > 0:
+                    _trace = os.environ.get("SHEEPRL_TPU_TRACE")
+                    with telem.span("Time/train_time"):
+                        _tt = time.perf_counter()
+                        batches = prefetch.take(per_rank_gradient_steps)  # [G, T, B, ...]
+                        _t_take = time.perf_counter()
+                        root_key, sub = jax.random.split(root_key)
+                        _t_split = time.perf_counter()
+                        params, opt_states, moments, metrics = train(
+                            params,
+                            opt_states,
+                            moments,
+                            batches,
+                            jax.random.split(sub, per_rank_gradient_steps),
+                        )
+                        _t_disp = time.perf_counter()
+                    # metrics stay on device until log time — no per-step host sync
+                    if not MetricAggregator.disabled:
+                        # device refs held until the log-cadence host sync;
+                        # skip entirely when metrics are off (bench legs)
+                        pending_metrics.append(metrics)
+                    if _trace:
+                        jax.tree.leaves(params)[0].block_until_ready()
+                        _t_exec = time.perf_counter()
+                    mirror.refresh({"wm": params["wm"], "actor": params["actor"]})
+                    if _trace:
+                        jax.tree.leaves(mirror._pending or mirror.params)[0].block_until_ready()
+                        _t_done = time.perf_counter()
+                        print(
+                            f"[trace] burst G={per_rank_gradient_steps} take={_t_take - _tt:.3f}"
+                            f" split={_t_split - _t_take:.3f} dispatch={_t_disp - _t_split:.3f}"
+                            f" exec={_t_exec - _t_disp:.3f} refresh={_t_done - _t_exec:.3f}",
+                            file=sys.stderr,
+                            flush=True,
+                        )
+                    run_info.mark_steady(policy_step, sync=lambda: jax.block_until_ready(metrics))
+                if policy_step < total_steps:
+                    # overlap the next sample + host→HBM transfer with the train
+                    # step the device is computing right now
+                    _tt = time.perf_counter()
+                    prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
+                    if per_rank_gradient_steps > 0 and os.environ.get("SHEEPRL_TPU_TRACE"):
+                        print(f"[trace] stage={time.perf_counter() - _tt:.3f}", file=sys.stderr, flush=True)
+
+            flush_logs()
+            maybe_checkpoint()
 
     guard.close(policy_step, _ckpt_state)
     envs.close()

@@ -41,7 +41,7 @@ from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...resilience import RunGuard
-from ...utils.utils import Ratio, save_configs
+from ...utils.utils import Ratio, acknowledge_partial_donation, save_configs
 from ..dreamer_v2.agent import DV2WorldModel, dv2_actor_dists, dv2_sample_actions
 from ..dreamer_v2.dreamer_v2 import _build_buffer, make_player as make_dreamer_player
 from ..dreamer_v2.loss import reconstruction_loss
@@ -384,11 +384,15 @@ def make_train_fn(
         }
         return params, opt_states, metrics
 
-    @partial(jax.jit, donate_argnums=(0, 1))
+    acknowledge_partial_donation()  # uint8/flag leaves can't alias; expected
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
     def train(params, opt_states, batches, keys):
         """G gradient steps in one device call: scan `one_step` over
         `batches` [G, T, B, ...] / `keys` [G]; metrics come back [G]-shaped
-        (see dreamer_v3.make_train_fn for the rationale)."""
+        (see dreamer_v3.make_train_fn for the rationale — incl. why
+        `batches` is donated: the biggest transient HBM buffer, consumed
+        once; callers must pass fresh arrays every burst)."""
 
         def body(carry, xs):
             params, opt_states = carry

@@ -53,7 +53,7 @@ from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...resilience import RunGuard
-from ...utils.utils import Ratio, save_configs
+from ...utils.utils import Ratio, acknowledge_partial_donation, save_configs
 from ..dreamer_v3.agent import WorldModel, actor_dists, sample_actor_actions
 from ..dreamer_v3.dreamer_v3 import make_player
 from ..dreamer_v3.loss import reconstruction_loss
@@ -467,11 +467,15 @@ def make_train_fn(
             metrics[f"Loss/value_loss_exploration_{name}"] = v
         return params, opt_states, moments, metrics
 
-    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    acknowledge_partial_donation()  # uint8/flag leaves can't alias; expected
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
     def train(params, opt_states, moments, batches, keys):
         """G gradient steps in one device call: scan `one_step` over
         `batches` [G, T, B, ...] / `keys` [G]; metrics come back [G]-shaped
-        (see dreamer_v3.make_train_fn for the rationale)."""
+        (see dreamer_v3.make_train_fn for the rationale — incl. why
+        `batches` is donated: the biggest transient HBM buffer, consumed
+        once; callers must pass fresh arrays every burst)."""
 
         def body(carry, xs):
             params, opt_states, moments = carry

@@ -31,6 +31,7 @@ import optax
 
 from ...config import Config, instantiate
 from ...data import ReplayBuffer
+from ...engine import OverlapEngine, Packet
 from ...ops import gae as gae_op
 from ...optim import clipped
 from ...parallel import Distributed
@@ -206,122 +207,135 @@ def main(dist: Distributed, cfg: Config) -> None:
     obs, _ = envs.reset(seed=cfg.seed)
 
     def _ckpt_state():
+        # `completed_update` = the last update whose params this checkpoint
+        # carries (resume restarts at +1). The overlapped loop can break at
+        # the TOP of an iteration (preemption/wall-cap before the update
+        # ran), so the loop counter itself would over-count by one there.
         return {
             "params": params,
             "opt_state": opt_state,
-            "update": update_iter,
+            "update": completed_update,
             "policy_step": policy_step,
             "last_log": last_log,
             "last_checkpoint": last_checkpoint,
             "rng": root_key,
         }
 
-    for update_iter in range(start_iter, num_updates + 1):
-        telem.tick(policy_step)
-        with telem.span("Time/env_interaction_time"):
-            for _ in range(rollout_steps):
-                device_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
-                player_key, act_key = jax.random.split(player_key)
-                actions, logprobs, values = act(mirror.current(), device_obs, act_key)
-                np_actions = np.asarray(actions)
-                if module.is_continuous:
-                    env_actions = np_actions.reshape(num_envs, -1)
-                elif isinstance(action_space, gym.spaces.MultiDiscrete):
-                    env_actions = np_actions.reshape(num_envs, -1)
-                else:
-                    env_actions = np_actions.reshape(num_envs)
-                next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
-                policy_step += num_envs
+    def rollout(buf):
+        """One rollout_steps collection (reference ppo.py:232-312): acts
+        with the mirror snapshot, fills `buf`, and returns
+        ``(local [T, N, ...] dict, bootstrap next_value, episode stats)``.
+        Runs on the calling thread serially, on the player thread under the
+        overlap engine (everything it touches — envs, mirror, rollout
+        buffer, player_key — is player-owned; episode stats are RETURNED,
+        not aggregated, because the aggregator is not thread-safe and its
+        writes must stay on the learner thread)."""
+        nonlocal obs, player_key
+        ep_stats = []
+        for _ in range(rollout_steps):
+            device_obs = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
+            player_key, act_key = jax.random.split(player_key)
+            actions, logprobs, values = act(mirror.current(), device_obs, act_key)
+            np_actions = np.asarray(actions)
+            if module.is_continuous:
+                env_actions = np_actions.reshape(num_envs, -1)
+            elif isinstance(action_space, gym.spaces.MultiDiscrete):
+                env_actions = np_actions.reshape(num_envs, -1)
+            else:
+                env_actions = np_actions.reshape(num_envs)
+            next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
 
-                rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, 1)
-                dones = np.logical_or(terminated, truncated).astype(np.float32).reshape(num_envs, 1)
+            rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, 1)
+            dones = np.logical_or(terminated, truncated).astype(np.float32).reshape(num_envs, 1)
 
-                # truncation bootstrapping (reference ppo.py:286-305)
-                if np.any(truncated) and "final_obs" in info:
-                    final_obs = info["final_obs"]
-                    trunc_idx = np.nonzero(truncated)[0]
-                    stacked = {
-                        k: np.stack([np.asarray(final_obs[i][k]) for i in trunc_idx])
-                        for k in obs_keys
-                    }
-                    vals = np.asarray(
-                        value_fn(
-                            mirror.current(),
-                            prepare_obs(stacked, cnn_keys, mlp_keys, len(trunc_idx)),
-                        )
+            # truncation bootstrapping (reference ppo.py:286-305)
+            if np.any(truncated) and "final_obs" in info:
+                final_obs = info["final_obs"]
+                trunc_idx = np.nonzero(truncated)[0]
+                stacked = {
+                    k: np.stack([np.asarray(final_obs[i][k]) for i in trunc_idx])
+                    for k in obs_keys
+                }
+                vals = np.asarray(
+                    value_fn(
+                        mirror.current(),
+                        prepare_obs(stacked, cnn_keys, mlp_keys, len(trunc_idx)),
                     )
-                    rewards[trunc_idx] += cfg.algo.gamma * vals.reshape(-1, 1)
+                )
+                rewards[trunc_idx] += cfg.algo.gamma * vals.reshape(-1, 1)
 
-                step_data: Dict[str, np.ndarray] = {}
-                for k in obs_keys:
-                    step_data[f"obs:{k}"] = np.asarray(obs[k]).reshape(1, num_envs, *obs_space[k].shape)
-                step_data["actions"] = np_actions.reshape(1, num_envs, -1).astype(np.float32)
-                step_data["logprobs"] = np.asarray(logprobs).reshape(1, num_envs, 1)
-                step_data["values"] = np.asarray(values).reshape(1, num_envs, 1)
-                step_data["rewards"] = rewards.reshape(1, num_envs, 1)
-                step_data["dones"] = dones.reshape(1, num_envs, 1)
-                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            step_data: Dict[str, np.ndarray] = {}
+            for k in obs_keys:
+                step_data[f"obs:{k}"] = np.asarray(obs[k]).reshape(1, num_envs, *obs_space[k].shape)
+            step_data["actions"] = np_actions.reshape(1, num_envs, -1).astype(np.float32)
+            step_data["logprobs"] = np.asarray(logprobs).reshape(1, num_envs, 1)
+            step_data["values"] = np.asarray(values).reshape(1, num_envs, 1)
+            step_data["rewards"] = rewards.reshape(1, num_envs, 1)
+            step_data["dones"] = dones.reshape(1, num_envs, 1)
+            buf.add(step_data, validate_args=cfg.buffer.validate_args)
 
-                obs = next_obs
+            obs = next_obs
 
-                for ep_rew, ep_len in episode_stats(info):
-                    aggregator.update("Rewards/rew_avg", ep_rew)
-                    aggregator.update("Game/ep_len_avg", ep_len)
+            ep_stats.extend(episode_stats(info))
+        # mirror params: keeps the bootstrap off the remote link (the GAE
+        # scan then runs on the player device; data is tiny [T, N])
+        next_value = value_fn(mirror.current(), prepare_obs(obs, cnn_keys, mlp_keys, num_envs))
+        return buf.buffer, next_value, ep_stats
 
-        # -- estimate returns (device, reverse scan) -----------------------
-        with telem.span("Time/train_time"):
-            local = rb.buffer  # [T, N, ...]
-            # mirror params: keeps the bootstrap off the remote link (the GAE
-            # scan then runs on the player device; data is tiny [T, N])
-            next_value = value_fn(mirror.current(), prepare_obs(obs, cnn_keys, mlp_keys, num_envs))
-            returns, advantages = gae_fn(
-                jnp.asarray(local["rewards"]),
-                jnp.asarray(local["values"]),
-                jnp.asarray(local["dones"]),
-                next_value,
-            )
-
-            data = {k: jnp.asarray(v).reshape(total_batch, *v.shape[2:]) for k, v in local.items()}
-            data["returns"] = returns.reshape(total_batch, 1)
-            data["advantages"] = advantages.reshape(total_batch, 1)
-            data = {k: jax.device_put(v, dist.batch_sharding) for k, v in data.items()}
-
-            # anneal (traced scalars → no retrace; reference ppo.py:414-424)
-            frac = 1.0
-            if cfg.algo.anneal_lr:
-                frac = 1.0 - (update_iter - 1) / max(num_updates, 1)
-            coefs = {
-                "clip_coef": jnp.asarray(
-                    linear_annealing(cfg.algo.clip_coef, update_iter - 1, num_updates)
-                    if cfg.algo.anneal_clip_coef
-                    else cfg.algo.clip_coef,
-                    jnp.float32,
-                ),
-                "ent_coef": jnp.asarray(
-                    linear_annealing(cfg.algo.ent_coef, update_iter - 1, num_updates)
-                    if cfg.algo.anneal_ent_coef
-                    else cfg.algo.ent_coef,
-                    jnp.float32,
-                ),
-                "vf_coef": jnp.asarray(cfg.algo.vf_coef, jnp.float32),
-                "lr_frac": jnp.asarray(frac, jnp.float32),
-            }
-            root_key, up_key = jax.random.split(root_key)
-            params, opt_state, metrics = update(params, opt_state, data, coefs, up_key)
-            telem.record_grad_steps(num_minibatches * int(cfg.algo.update_epochs))
-            mirror.refresh(params)  # blocking: next rollout acts with fresh params
-            run_info.mark_steady(policy_step)
-
+    def record_ep_stats(ep_stats) -> None:
         if aggregator is not None:
-            for k, v in metrics.items():
-                aggregator.update(k, np.asarray(v))
+            for ep_rew, ep_len in ep_stats:
+                aggregator.update("Rewards/rew_avg", ep_rew)
+                aggregator.update("Game/ep_len_avg", ep_len)
 
-        # -- logging -------------------------------------------------------
+    def update_from(local, next_value, update_iter):
+        """GAE + the whole jitted update for one rollout (learner side)."""
+        nonlocal params, opt_state, root_key
+        returns, advantages = gae_fn(
+            jnp.asarray(local["rewards"]),
+            jnp.asarray(local["values"]),
+            jnp.asarray(local["dones"]),
+            jnp.asarray(next_value),
+        )
+
+        data = {k: jnp.asarray(v).reshape(total_batch, *v.shape[2:]) for k, v in local.items()}
+        data["returns"] = returns.reshape(total_batch, 1)
+        data["advantages"] = advantages.reshape(total_batch, 1)
+        data = {k: jax.device_put(v, dist.batch_sharding) for k, v in data.items()}
+
+        # anneal (traced scalars → no retrace; reference ppo.py:414-424)
+        frac = 1.0
+        if cfg.algo.anneal_lr:
+            frac = 1.0 - (update_iter - 1) / max(num_updates, 1)
+        coefs = {
+            "clip_coef": jnp.asarray(
+                linear_annealing(cfg.algo.clip_coef, update_iter - 1, num_updates)
+                if cfg.algo.anneal_clip_coef
+                else cfg.algo.clip_coef,
+                jnp.float32,
+            ),
+            "ent_coef": jnp.asarray(
+                linear_annealing(cfg.algo.ent_coef, update_iter - 1, num_updates)
+                if cfg.algo.anneal_ent_coef
+                else cfg.algo.ent_coef,
+                jnp.float32,
+            ),
+            "vf_coef": jnp.asarray(cfg.algo.vf_coef, jnp.float32),
+            "lr_frac": jnp.asarray(frac, jnp.float32),
+        }
+        root_key, up_key = jax.random.split(root_key)
+        params, opt_state, metrics = update(params, opt_state, data, coefs, up_key)
+        telem.record_grad_steps(num_minibatches * int(cfg.algo.update_epochs))
+        return metrics
+
+    def flush_logs() -> None:
+        nonlocal last_log
         if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
             telem.log(policy_step)
             last_log = policy_step
 
-        # -- checkpoint ----------------------------------------------------
+    def maybe_checkpoint(update_iter) -> None:
+        nonlocal last_checkpoint
         if (
             cfg.checkpoint.every > 0
             and policy_step - last_checkpoint >= cfg.checkpoint.every
@@ -329,8 +343,102 @@ def main(dist: Distributed, cfg: Config) -> None:
             last_checkpoint = policy_step
             ckpt.save(policy_step, _ckpt_state())
 
-        if guard.stop_reached(policy_step, int(cfg.algo.total_steps), _ckpt_state):
-            break
+    engine = OverlapEngine.setup(
+        cfg,
+        telem,
+        guard,
+        total_steps=num_updates * policy_steps_per_iter,
+        initial_step=policy_step,
+        default_queue_depth=1,  # at most one rollout ahead of the learner
+    )
+    update_iter = start_iter
+    completed_update = start_iter - 1
+    if engine.enabled:
+        # ---- overlapped rollout/update loop (engine/overlap.py): the
+        # player collects rollout k+1 against the pre-update mirror snapshot
+        # (staleness = one update; the clipped surrogate absorbs it) while
+        # the learner updates on rollout k ------------------------------
+        # ping-pong rollout buffers instead of a per-update deep copy: with
+        # the engine's pre-collection backpressure, a buffer is only refilled
+        # after the learner has consumed the packet queue_depth packets back,
+        # so queue_depth+1 buffers cycled round-robin are race-free and the
+        # multi-MB snapshot copy disappears from the player's critical path.
+        bufs = [rb] + [
+            ReplayBuffer(
+                rollout_steps,
+                num_envs,
+                obs_keys=obs_keys,
+                memmap=cfg.buffer.memmap,
+                memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}_overlap{i}")
+                if cfg.buffer.memmap
+                else None,
+                seed=cfg.seed + 1024 * rank + 7 * (i + 1),
+            )
+            for i in range(engine.queue_depth)
+        ]
+        buf_idx = [0]
+
+        def play() -> Packet:
+            buf = bufs[buf_idx[0] % len(bufs)]
+            buf_idx[0] += 1
+            with telem.span("Time/env_interaction_time"):
+                local, next_value, ep_stats = rollout(buf)
+            return Packet((local, np.asarray(next_value), ep_stats), policy_steps_per_iter)
+
+        engine.start(play)
+        stopped = False
+        while update_iter <= num_updates:
+            telem.tick(policy_step)
+            if guard.stop_reached(policy_step, int(cfg.algo.total_steps), None, save=False):
+                stopped = True
+                break
+            pkts = engine.take(max_packets=1)
+            if not pkts:
+                break
+            local, next_value, ep_stats = pkts[0].payload
+            policy_step += pkts[0].env_steps
+            record_ep_stats(ep_stats)  # learner-thread aggregator writes only
+            with telem.span("Time/train_time"):
+                metrics = update_from(local, next_value, update_iter)
+                mirror.refresh(params)  # blocking: the next rollout acts with these
+                engine.published()  # release take()'s claim: unblocks a strict player
+                run_info.mark_steady(policy_step)
+            completed_update = update_iter
+            if aggregator is not None:
+                for k, v in metrics.items():
+                    aggregator.update(k, np.asarray(v))  # host-sync: ok (update cadence)
+            flush_logs()
+            maybe_checkpoint(update_iter)
+            update_iter += 1
+        # a queued rollout (collected for params that will never act again)
+        # is dropped: PPO keeps no cross-update buffer to stay consistent
+        engine.shutdown()
+        if stopped and not guard.preempted and cfg.checkpoint.save_last:
+            ckpt.save(policy_step, _ckpt_state())
+    else:
+        # ---- serial loop (reference semantics) ---------------------------
+        for update_iter in range(start_iter, num_updates + 1):
+            telem.tick(policy_step)
+            with telem.span("Time/env_interaction_time"):
+                local, next_value, ep_stats = rollout(rb)
+            policy_step += policy_steps_per_iter
+            record_ep_stats(ep_stats)
+
+            with telem.span("Time/train_time"):
+                metrics = update_from(local, next_value, update_iter)
+                mirror.refresh(params)  # blocking: next rollout acts with fresh params
+                run_info.mark_steady(policy_step)
+            completed_update = update_iter
+
+            if aggregator is not None:
+                for k, v in metrics.items():
+                    aggregator.update(k, np.asarray(v))  # host-sync: ok (update cadence)
+
+            flush_logs()
+            maybe_checkpoint(update_iter)
+
+            if guard.stop_reached(policy_step, int(cfg.algo.total_steps), _ckpt_state):
+                break
 
     guard.close(policy_step, _ckpt_state)
     envs.close()

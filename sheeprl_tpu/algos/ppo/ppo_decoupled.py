@@ -315,7 +315,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             # metrics / logging / checkpoint run while the player is blocked
             # on params_q.get() (the span tracker is thread-safe regardless)
             for k, v in metrics.items():
-                aggregator.update(k, np.asarray(v))
+                aggregator.update(k, np.asarray(v))  # host-sync: ok (update cadence)
 
             if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
                 telem.log(policy_step)

@@ -393,7 +393,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             mirror.refresh(params)  # blocking: next rollout acts with fresh params
 
         for k, v in metrics.items():
-            aggregator.update(k, np.asarray(v))
+            aggregator.update(k, np.asarray(v))  # host-sync: ok (update cadence)
 
         if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
             telem.log(policy_step)

@@ -28,6 +28,7 @@ import optax
 from ...config import Config, instantiate
 from ...data import ReplayBuffer
 from ...data.device_ring import estimate_row_bytes, make_uniform_prefetcher
+from ...engine import BufferOpSink, OverlapEngine, Packet, RecordingSink
 from ...parallel import Distributed
 from ...parallel.placement import make_param_mirror
 from ...telemetry import Telemetry
@@ -233,64 +234,51 @@ def main(dist: Distributed, cfg: Config) -> None:
             s["rb"] = rb.checkpoint_state_dict()
         return s
 
-    while policy_step < total_steps:
-        telem.tick(policy_step)
-        if guard.stop_reached(policy_step, total_steps, _ckpt_state):
-            break
-        with telem.span("Time/env_interaction_time"):
-            if policy_step <= learning_starts:
-                env_actions = np.stack([action_space.sample() for _ in range(num_envs)])
-            else:
-                player_key, k = jax.random.split(player_key)
-                env_actions = np.asarray(
-                    act(mirror.current()["actor"], obs_vec, k)
-                ).reshape(num_envs, act_dim)
-            next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
-            policy_step += num_envs
+    p_step = policy_step  # player-side env-step counter (== policy_step serially)
 
-            # true next obs for the buffer: the final obs on done envs
-            real_next = flatten_obs(next_obs, mlp_keys, num_envs).copy()
-            if "final_obs" in info:
-                for i, fo in enumerate(info["final_obs"]):
-                    if fo is not None:
-                        real_next[i] = np.concatenate(
-                            [np.asarray(fo[k], np.float32).reshape(-1) for k in mlp_keys]
-                        )
+    def interact(sink) -> None:
+        """ONE vector env step (reference sac.py env block): act from the
+        mirror snapshot, record the replay row into `sink` — the real buffer
+        serially (no copies), a `RecordingSink` packet under overlap."""
+        nonlocal obs_vec, player_key, p_step
+        if p_step <= learning_starts:
+            env_actions = np.stack([action_space.sample() for _ in range(num_envs)])
+        else:
+            player_key, k = jax.random.split(player_key)
+            env_actions = np.asarray(
+                act(mirror.current()["actor"], obs_vec, k)
+            ).reshape(num_envs, act_dim)
+        next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
+        p_step += num_envs
 
-            step_data = {
-                "observations": obs_vec.reshape(1, num_envs, -1),
-                "next_observations": real_next.reshape(1, num_envs, -1),
-                "actions": env_actions.reshape(1, num_envs, act_dim).astype(np.float32),
-                "rewards": np.asarray(rewards, np.float32).reshape(1, num_envs, 1),
-                "terminated": np.asarray(terminated, np.float32).reshape(1, num_envs, 1),
-                "dones": np.logical_or(terminated, truncated).astype(np.float32).reshape(1, num_envs, 1),
-            }
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
-            obs_vec = flatten_obs(next_obs, mlp_keys, num_envs)
+        # true next obs for the buffer: the final obs on done envs
+        real_next = flatten_obs(next_obs, mlp_keys, num_envs).copy()
+        if "final_obs" in info:
+            for i, fo in enumerate(info["final_obs"]):
+                if fo is not None:
+                    real_next[i] = np.concatenate(
+                        [np.asarray(fo[k], np.float32).reshape(-1) for k in mlp_keys]
+                    )
 
-            for ep_rew, ep_len in episode_stats(info):
-                aggregator.update("Rewards/rew_avg", ep_rew)
-                aggregator.update("Game/ep_len_avg", ep_len)
+        step_data = {
+            "observations": obs_vec.reshape(1, num_envs, -1),
+            "next_observations": real_next.reshape(1, num_envs, -1),
+            "actions": env_actions.reshape(1, num_envs, act_dim).astype(np.float32),
+            "rewards": np.asarray(rewards, np.float32).reshape(1, num_envs, 1),
+            "terminated": np.asarray(terminated, np.float32).reshape(1, num_envs, 1),
+            "dones": np.logical_or(terminated, truncated).astype(np.float32).reshape(1, num_envs, 1),
+        }
+        sink.add(step_data, validate_args=cfg.buffer.validate_args)
+        obs_vec = flatten_obs(next_obs, mlp_keys, num_envs)
 
-        if policy_step >= learning_starts:
-            per_rank_gradient_steps = ratio(policy_step / dist.world_size)
-            telem.record_grad_steps(per_rank_gradient_steps)
-            if per_rank_gradient_steps > 0:
-                with telem.span("Time/train_time"):
-                    batches = prefetch.take(per_rank_gradient_steps)  # [G, B, ...]
-                    root_key, sub = jax.random.split(root_key)
-                    keys = jax.random.split(sub, per_rank_gradient_steps)
-                    params, opt_states, metrics = train(params, opt_states, batches, keys)
-                    cumulative_grad_steps += per_rank_gradient_steps
-                if not MetricAggregator.disabled:
-                    # device refs held until the log-cadence host sync;
-                    # skip entirely when metrics are off (bench legs)
-                    pending_metrics.append(metrics)
-                mirror.refresh({"actor": params["actor"]})
-                run_info.mark_steady(policy_step, sync=lambda: jax.block_until_ready(metrics))
-            if policy_step < total_steps:
-                prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
+        for ep_rew, ep_len in episode_stats(info):
+            # through the sink: the aggregator is not thread-safe, so under
+            # overlap these ride the packet and land on the learner thread
+            sink.stat("Rewards/rew_avg", ep_rew)
+            sink.stat("Game/ep_len_avg", ep_len)
 
+    def flush_logs() -> None:
+        nonlocal last_log
         if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
             for m in pending_metrics:  # host-sync deferred to log cadence
                 for k, v in m.items():
@@ -304,11 +292,105 @@ def main(dist: Distributed, cfg: Config) -> None:
             )
             last_log = policy_step
 
+    def maybe_checkpoint() -> None:
+        nonlocal last_checkpoint
         if (
             cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
         ) or cfg.dry_run or policy_step >= total_steps:
             last_checkpoint = policy_step
             ckpt.save(policy_step, _ckpt_state())
+
+    engine = OverlapEngine.setup(
+        cfg, telem, guard, total_steps=total_steps, initial_step=policy_step
+    )
+    if engine.enabled:
+        # ---- overlapped player/learner loop (engine/overlap.py) ----------
+        def play() -> Packet:
+            rec = RecordingSink()
+            with telem.span("Time/env_interaction_time"):
+                interact(rec)
+            return Packet(rec, num_envs)
+
+        engine.start(play)
+        stopped = False
+        while policy_step < total_steps:
+            telem.tick(policy_step)
+            if guard.stop_reached(policy_step, total_steps, None, save=False):
+                stopped = True
+                break
+            packets = engine.take()
+            if not packets:
+                break
+            gs = []
+            for pkt in packets:  # FIFO ack: the Ratio ledger matches serial
+                pkt.apply(rb, aggregator)
+                policy_step += pkt.env_steps
+                if policy_step >= learning_starts:
+                    g = ratio(policy_step / dist.world_size)
+                    telem.record_grad_steps(g)
+                    gs.append(g)
+            bursting = False
+            for i, g in enumerate(gs):
+                if g <= 0:
+                    continue
+                with telem.span("Time/train_time"):
+                    bursting = True
+                    batches = prefetch.take(g)  # [G, B, ...]
+                    root_key, sub = jax.random.split(root_key)
+                    params, opt_states, metrics = train(
+                        params, opt_states, batches, jax.random.split(sub, g)
+                    )
+                    cumulative_grad_steps += g
+                if not MetricAggregator.disabled:
+                    pending_metrics.append(metrics)
+                nxt = next((x for x in gs[i + 1 :] if x > 0), 0)
+                if nxt > 0:
+                    prefetch.stage(nxt)
+            if bursting:
+                mirror.refresh({"actor": params["actor"]})
+                run_info.mark_steady(policy_step, sync=lambda: jax.block_until_ready(metrics))
+            engine.published()  # release take()'s claim every iteration
+            if policy_step < total_steps:
+                prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
+            flush_logs()
+            maybe_checkpoint()
+        # drain: queued transitions land in the buffer so the final
+        # checkpoint is consistent (ratio catches up at resume)
+        policy_step += engine.shutdown(lambda pkt: pkt.apply(rb, aggregator))
+        if stopped and not guard.preempted and cfg.checkpoint.save_last:
+            ckpt.save(policy_step, _ckpt_state())
+    else:
+        # ---- serial loop (reference semantics) ---------------------------
+        sink = BufferOpSink(rb, aggregator)
+        while policy_step < total_steps:
+            telem.tick(policy_step)
+            if guard.stop_reached(policy_step, total_steps, _ckpt_state):
+                break
+            with telem.span("Time/env_interaction_time"):
+                interact(sink)
+            policy_step = p_step
+
+            if policy_step >= learning_starts:
+                per_rank_gradient_steps = ratio(policy_step / dist.world_size)
+                telem.record_grad_steps(per_rank_gradient_steps)
+                if per_rank_gradient_steps > 0:
+                    with telem.span("Time/train_time"):
+                        batches = prefetch.take(per_rank_gradient_steps)  # [G, B, ...]
+                        root_key, sub = jax.random.split(root_key)
+                        keys = jax.random.split(sub, per_rank_gradient_steps)
+                        params, opt_states, metrics = train(params, opt_states, batches, keys)
+                        cumulative_grad_steps += per_rank_gradient_steps
+                    if not MetricAggregator.disabled:
+                        # device refs held until the log-cadence host sync;
+                        # skip entirely when metrics are off (bench legs)
+                        pending_metrics.append(metrics)
+                    mirror.refresh({"actor": params["actor"]})
+                    run_info.mark_steady(policy_step, sync=lambda: jax.block_until_ready(metrics))
+                if policy_step < total_steps:
+                    prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
+
+            flush_logs()
+            maybe_checkpoint()
 
     guard.close(policy_step, _ckpt_state)
     envs.close()

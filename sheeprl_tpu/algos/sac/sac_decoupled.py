@@ -293,7 +293,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             # quiescent, so snapshots are consistent (no torn rb.state_dict;
             # the span tracker is thread-safe regardless)
             for k, v in metrics.items():
-                aggregator.update(k, np.asarray(v))
+                aggregator.update(k, np.asarray(v))  # host-sync: ok (trainer-iteration cadence)
 
             if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
                 telem.log(

@@ -30,6 +30,7 @@ from ...telemetry import Telemetry
 from ...utils.checkpoint import CheckpointManager
 from ...utils.env import episode_stats, vectorize
 from ...utils.logger import get_log_dir, get_logger
+from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
 from ...resilience import RunGuard
 from ...utils.utils import Ratio, save_configs
@@ -292,6 +293,8 @@ def main(dist: Distributed, cfg: Config) -> None:
             s["rb"] = rb.checkpoint_state_dict()
         return s
 
+    pending_metrics: list = []
+
     while policy_step < total_steps:
         telem.tick(policy_step)
         if guard.stop_reached(policy_step, total_steps, _ckpt_state):
@@ -343,14 +346,20 @@ def main(dist: Distributed, cfg: Config) -> None:
                     keys = jax.random.split(sub, g)
                     params, opt_states, metrics = train(params, opt_states, batches, keys)
                     mirror.refresh({"encoder": params["encoder"], "actor": params["actor"]})
-                for k, v in metrics.items():
-                    aggregator.update(k, np.asarray(v))
+                if not MetricAggregator.disabled:
+                    # device refs held until the log-cadence host sync;
+                    # skip entirely when metrics are off (bench legs)
+                    pending_metrics.append(metrics)
             if policy_step < total_steps:
                 # overlap the next sample (and its transfer/gather) with the
                 # train burst the device is computing right now
                 prefetch.stage(ratio.peek((policy_step + num_envs) / dist.world_size))
 
         if policy_step - last_log >= cfg.metric.log_every or cfg.dry_run:
+            for m in pending_metrics:  # host-sync deferred to log cadence
+                for k, v in m.items():
+                    aggregator.update(k, np.asarray(v))
+            pending_metrics.clear()
             telem.log(policy_step)
             last_log = policy_step
 

@@ -28,6 +28,12 @@ class StagedPrefetcher:
     latest ``num_envs`` transitions; for off-policy replay from a large
     buffer this is statistically irrelevant (and the first train phase, or
     any `g` misprediction, falls back to a synchronous sample).
+
+    Thread ownership: `stage`/`take` (and the buffer they sample from) are
+    LEARNER-thread-only — under the overlap engine (`engine/overlap.py`)
+    the player hands transitions across a queue and the learner applies
+    them to the buffer before sampling, so the buffer never sees two
+    threads (no torn rows, consistent checkpoints).
     """
 
     def __init__(self, sample_fn: Callable[[int], Any], sharding: Optional[Any] = None):

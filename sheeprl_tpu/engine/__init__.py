@@ -1,0 +1,7 @@
+"""Execution engines: reusable loop drivers that decide *when* things run
+(concurrency, overlap, cadence), while the algorithms keep deciding *what*
+runs (losses, agents, buffers)."""
+
+from .overlap import BufferOpSink, OverlapEngine, Packet, RecordingSink, SpscRing
+
+__all__ = ["BufferOpSink", "OverlapEngine", "Packet", "RecordingSink", "SpscRing"]

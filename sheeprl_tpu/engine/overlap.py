@@ -1,0 +1,490 @@
+"""Overlapped player/learner engine: concurrent acting + training with
+bounded staleness.
+
+The serial loops interleave env interaction and gradient bursts in one
+thread, so the device idles while Python steps environments, and the
+player's jitted ``act`` dispatches queue behind the scanned train burst on
+the same device stream. The fix is the Podracer/Sebulba split (arXiv:
+2104.06272), re-derived for a single-controller JAX process:
+
+* the **player thread** steps the envs, acting against the existing
+  :class:`~sheeprl_tpu.parallel.placement.ParamMirror` snapshot — on a
+  multi-device mesh its jitted ``act`` is pinned to the mirror device, so
+  act dispatches stop contending with the train burst's device stream; on a
+  single device this degrades to overlapping host-side env stepping with
+  the learner's async device compute;
+* the **learner thread** (the caller) drains transitions from a bounded
+  SPSC queue into the replay buffer / prefetcher and runs the scanned
+  gradient bursts;
+* **staleness is bounded to one burst**: the player always acts with the
+  latest *published* params, so the only staleness is the burst currently
+  in flight on the learner (packets record it; the gate enforces the
+  configured bound if a future learner ever pipelines bursts);
+* **replay-ratio accounting is exact**: the learner feeds the `Ratio`
+  controller one call per acknowledged packet, in FIFO order, with the
+  same ``policy_step`` arguments the serial loop would have used — the
+  env-step:grad-step ledger is bit-identical to the serial loop's.
+
+Integration contract (what each adopted algorithm provides):
+
+* a ``play_fn()`` closure — ONE env-interaction slice (one vector step for
+  Dreamer/SAC, one full rollout for PPO) that records its replay-buffer
+  mutations into a :class:`RecordingSink` and returns a :class:`Packet`;
+* an ``absorb(packet)`` learner-side apply (usually ``packet.apply(rb)``);
+* ``engine.burst_started()`` / ``engine.published()`` around the train
+  burst + mirror refresh, so the engine can account staleness and stalls.
+
+`RunGuard` integration: the player stops feeding as soon as preemption is
+requested (its queue waits poll ``guard.preempted``); the learner breaks at
+its own ``guard.stop_reached`` boundary, finishes the in-flight burst, and
+``engine.shutdown(absorb)`` joins the player and drains the queued
+transitions into the buffer so the final checkpoint sees a consistent
+buffer (policy-step counter == buffer content; the replay-ratio controller
+catches up on resume).
+
+Telemetry: the engine emits ``overlap`` JSONL events (player-stall /
+learner-stall / queue-depth / staleness) through the run's event stream,
+and the player times its env slices under the usual
+``Time/env_interaction_time`` span — overlapping the learner's
+``Time/train_time`` span in the same log interval is the visible win.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["BufferOpSink", "OverlapEngine", "Packet", "RecordingSink", "SpscRing"]
+
+
+class SpscRing:
+    """Bounded single-producer / single-consumer ring queue.
+
+    Lock-free on the data path: the producer only writes ``_tail``, the
+    consumer only writes ``_head``; CPython attribute stores/loads of ints
+    are atomic under the GIL, so no lock is needed for correctness. Blocking
+    behaviour (with stall accounting and cooperative stop) lives in the
+    engine, built on the non-blocking ``try_put``/``try_get``.
+    """
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._cap = int(capacity) + 1  # one slot sacrificed to tell full/empty
+        self._buf: List[Any] = [None] * self._cap
+        self._head = 0  # next slot to read (consumer-owned)
+        self._tail = 0  # next slot to write (producer-owned)
+
+    def __len__(self) -> int:
+        return (self._tail - self._head) % self._cap
+
+    @property
+    def capacity(self) -> int:
+        return self._cap - 1
+
+    def try_put(self, item: Any) -> bool:
+        nxt = (self._tail + 1) % self._cap
+        if nxt == self._head:
+            return False  # full
+        self._buf[self._tail] = item
+        self._tail = nxt  # publish AFTER the slot is written
+        return True
+
+    def try_get(self) -> Any:
+        """The next item, or the ring itself as a 'empty' sentinel (None is
+        a legal item)."""
+        head = self._head
+        if head == self._tail:
+            return self
+        item = self._buf[head]
+        self._buf[head] = None  # drop the ref so payloads don't linger
+        self._head = (head + 1) % self._cap
+        return item
+
+
+class Packet:
+    """One env-interaction slice crossing the player→learner queue."""
+
+    __slots__ = ("payload", "env_steps", "version", "staleness", "produced_t")
+
+    def __init__(self, payload: Any, env_steps: int):
+        self.payload = payload
+        self.env_steps = int(env_steps)
+        self.version = 0  # published-params version the player acted with
+        self.staleness = 0  # bursts in flight at production time (≤ bound)
+        self.produced_t = 0.0
+
+    # -- replay-buffer op payloads ----------------------------------------
+    def apply(self, rb: Any, aggregator: Any = None) -> None:
+        """Apply a :class:`RecordingSink` op-list payload (buffer ops +
+        deferred episode stats) to ``rb`` in production order (no-op for
+        non-op payloads)."""
+        if isinstance(self.payload, RecordingSink):
+            self.payload.apply(rb, aggregator)
+
+
+class BufferOpSink:
+    """Pass-through sink: the serial path — ops hit the buffer (and metric
+    aggregator) directly, with no copies. Shares the recorder's interface
+    so the interaction closure is written once for both modes."""
+
+    __slots__ = ("rb", "aggregator")
+
+    def __init__(self, rb: Any, aggregator: Any = None):
+        self.rb = rb
+        self.aggregator = aggregator
+
+    def add(self, data: Dict[str, np.ndarray], idxes: Any = None, validate_args: bool = False) -> None:
+        if idxes is None:
+            self.rb.add(data, validate_args=validate_args)
+        else:
+            self.rb.add(data, idxes, validate_args=validate_args)
+
+    def mark_restart(self, env_idx: int) -> None:
+        if hasattr(self.rb, "mark_restart"):
+            self.rb.mark_restart(int(env_idx))
+
+    def stat(self, key: str, value: Any) -> None:
+        if self.aggregator is not None:
+            self.aggregator.update(key, value)
+
+
+class RecordingSink:
+    """Records replay-buffer mutations player-side, to be applied
+    learner-side in the same order.
+
+    ``add`` **copies** its arrays: the interaction closures reuse/mutate
+    their ``step_data`` dicts across iterations (and gymnasium vector envs
+    reuse their obs buffers in place), and the learner may apply the op well
+    after the player has moved on. The copy is the price of the handoff —
+    the serial pass-through sink pays none.
+
+    ``stat`` records metric updates (episode reward/length) for the same
+    deferred apply: the aggregator has no locking, so all of its writes
+    must stay on the learner thread.
+    """
+
+    __slots__ = ("ops", "stats")
+
+    def __init__(self) -> None:
+        self.ops: List[tuple] = []
+        self.stats: List[tuple] = []
+
+    def add(self, data: Dict[str, np.ndarray], idxes: Any = None, validate_args: bool = False) -> None:
+        self.ops.append(
+            ("add", {k: np.array(v, copy=True) for k, v in data.items()}, idxes, validate_args)
+        )
+
+    def mark_restart(self, env_idx: int) -> None:
+        self.ops.append(("restart", int(env_idx), None, False))
+
+    def stat(self, key: str, value: Any) -> None:
+        self.stats.append((key, value))
+
+    def apply(self, rb: Any, aggregator: Any = None) -> None:
+        for op, a, idxes, validate in self.ops:
+            if op == "add":
+                if idxes is None:
+                    rb.add(a, validate_args=validate)
+                else:
+                    rb.add(a, idxes, validate_args=validate)
+            elif hasattr(rb, "mark_restart"):
+                rb.mark_restart(a)
+        if aggregator is not None:
+            for key, value in self.stats:
+                aggregator.update(key, value)
+        self.ops = []
+        self.stats = []
+
+
+_SLEEP_S = 0.0005  # park granularity for a blocked side (≪ one env step)
+
+
+class OverlapEngine:
+    """Concurrent player/learner driver with bounded staleness.
+
+    Construct via :meth:`setup`; when ``enabled`` is False every method is a
+    cheap no-op and the caller runs its serial loop unchanged.
+    """
+
+    def __init__(
+        self,
+        *,
+        enabled: bool = True,
+        queue_depth: int = 4,
+        staleness_bound: int = 1,
+        stats_every_s: float = 5.0,
+        total_steps: int = 0,
+        initial_step: int = 0,
+        telem: Any = None,
+        guard: Any = None,
+    ) -> None:
+        self.enabled = bool(enabled)
+        self.queue_depth = max(1, int(queue_depth))
+        # 0 is legal and means STRICT freshness: the player may not act while
+        # any burst is unpublished. Publishing happens right after the burst's
+        # async dispatch (not its device completion), so the player unblocks
+        # in microseconds and env stepping still overlaps device execution —
+        # this is the on-policy (PPO) mode: trajectories are bitwise-identical
+        # to the serial loop's, because the acting params are exactly the
+        # latest update's.
+        self.staleness_bound = max(0, int(staleness_bound))
+        self.stats_every_s = float(stats_every_s)
+        self.total_steps = int(total_steps)
+        self.initial_step = int(initial_step)
+        self.telem = telem
+        self.guard = guard
+
+        self._ring = SpscRing(self.queue_depth)
+        self._stop = threading.Event()
+        self._player_done = threading.Event()
+        self._player_exc: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+
+        # learner-owned counters (GIL-atomic int stores; the player only reads)
+        self._burst_seq = 0  # bursts started
+        self._pub_seq = 0  # bursts whose params the mirror has published
+        self.acked_steps = 0  # env steps handed to the learner
+        # player-owned counters (the learner only reads)
+        self.produced_steps = 0
+        self.packets_produced = 0
+
+        # interval stats (reset at each emit)
+        self._stats_lock = threading.Lock()
+        self._player_busy_s = 0.0
+        self._player_stall_s = 0.0
+        self._learner_stall_s = 0.0
+        self._staleness_max = 0
+        self.staleness_seen_max = 0  # whole-run high-water mark (tests)
+        self._last_emit_t = time.perf_counter()
+        self._events = 0
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def setup(
+        cls,
+        cfg: Any,
+        telem: Any = None,
+        guard: Any = None,
+        *,
+        total_steps: int,
+        initial_step: int = 0,
+        default_queue_depth: int = 4,
+    ) -> "OverlapEngine":
+        sel = cfg.select if hasattr(cfg, "select") else (lambda p, d=None: d)
+        # NOTE: no `or default` coercion — 0 is a meaningful staleness bound
+        # (strict on-policy mode), only None means "not configured"
+        sb = sel("algo.overlap.staleness_bound", 1)
+        se = sel("algo.overlap.stats_every_s", 5.0)
+        return cls(
+            enabled=bool(sel("algo.overlap.enabled", False)),
+            queue_depth=int(sel("algo.overlap.queue_depth", default_queue_depth) or default_queue_depth),
+            staleness_bound=int(1 if sb is None else sb),
+            stats_every_s=float(5.0 if se is None else se),
+            total_steps=total_steps,
+            initial_step=initial_step,
+            telem=telem,
+            guard=guard,
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, play_fn: Callable[[], Optional[Packet]]) -> "OverlapEngine":
+        """Spawn the player thread. ``play_fn()`` performs one env slice and
+        returns a Packet (or None to stop early)."""
+        if not self.enabled or self._thread is not None:
+            return self
+        self.produced_steps = self.initial_step
+        self.acked_steps = self.initial_step
+        self._thread = threading.Thread(
+            target=self._player_main, args=(play_fn,), name="overlap-player", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _should_stop(self) -> bool:
+        if self._stop.is_set():
+            return True
+        g = self.guard
+        return g is not None and getattr(g, "preempted", False)
+
+    def _player_main(self, play_fn: Callable[[], Optional[Packet]]) -> None:
+        try:
+            while not self._should_stop() and (
+                self.total_steps <= 0 or self.produced_steps < self.total_steps
+            ):
+                # Backpressure BEFORE acting, not after: wait for a free
+                # queue slot and for the staleness gate, THEN collect the
+                # slice. Waiting after collection would let the player act
+                # one slice beyond the bound with params one publish older
+                # than intended (e.g. PPO would collect rollout k+2 with
+                # params k-1 while update k is still running). The staleness
+                # gate itself (never act more than `staleness_bound` bursts
+                # behind the latest published params) cannot block with a
+                # synchronous learner and bound 1 — it is the enforced
+                # contract, the queue bound is the steady-state throttle.
+                t0 = time.perf_counter()
+                while (
+                    len(self._ring) >= self._ring.capacity
+                    or self._burst_seq - self._pub_seq > self.staleness_bound
+                ) and not self._should_stop():
+                    time.sleep(_SLEEP_S)
+                gate_s = time.perf_counter() - t0
+                if self._should_stop():
+                    break
+
+                t0 = time.perf_counter()
+                pkt = play_fn()
+                busy_s = time.perf_counter() - t0
+                if pkt is None:
+                    break
+                pkt.version = self._pub_seq
+                pkt.staleness = self._burst_seq - self._pub_seq
+                pkt.produced_t = time.perf_counter()
+
+                t0 = time.perf_counter()
+                # sole producer + pre-checked free slot: effectively
+                # immediate (the loop only guards the engine's invariants)
+                while not self._ring.try_put(pkt):
+                    if self._should_stop():
+                        return  # stop requested while blocked on a full queue
+                    time.sleep(_SLEEP_S)
+                stall_s = (time.perf_counter() - t0) + gate_s
+
+                self.produced_steps += pkt.env_steps
+                self.packets_produced += 1
+                with self._stats_lock:
+                    self._player_busy_s += busy_s
+                    self._player_stall_s += stall_s
+                    if pkt.staleness > self._staleness_max:
+                        self._staleness_max = pkt.staleness
+                    if pkt.staleness > self.staleness_seen_max:
+                        self.staleness_seen_max = pkt.staleness
+        except BaseException as e:  # surfaced on the learner's next take()
+            self._player_exc = e
+        finally:
+            self._player_done.set()
+
+    # -- learner side ------------------------------------------------------
+    def take(self, max_packets: int = 0) -> List[Packet]:
+        """Drain available packets (blocking for the first one). Returns []
+        when the player is done/stopped and the queue is empty — the learner
+        loop should break then. Raises if the player thread crashed.
+
+        A non-empty return CLAIMS a burst slot against the staleness gate;
+        the learner must release it with :meth:`published` once per
+        iteration (after the mirror refresh, if any training ran). The
+        claim is taken BEFORE the first packet leaves the ring, so between
+        a packet landing and its update publishing, a strict
+        (``staleness_bound=0``) player is always held by either the queue
+        bound or the claim — there is no instant where it could start
+        acting with pre-update params."""
+        out: List[Packet] = []
+        t0 = time.perf_counter()
+        stalled = 0.0
+        claimed = False
+        while True:
+            if len(self._ring) > 0:
+                if not claimed:
+                    claimed = True
+                    self._burst_seq += 1  # claim BEFORE the pop (see docstring)
+                item = self._ring.try_get()
+                if item is not self._ring:
+                    out.append(item)
+                    if max_packets and len(out) >= max_packets:
+                        break
+                    continue
+            if out:
+                break
+            if self._player_exc is not None:
+                raise RuntimeError("overlap player thread crashed") from self._player_exc
+            if self._player_done.is_set() or self._should_stop():
+                break
+            time.sleep(_SLEEP_S)
+            stalled = time.perf_counter() - t0
+        if self._player_exc is not None and not out:
+            raise RuntimeError("overlap player thread crashed") from self._player_exc
+        with self._stats_lock:
+            self._learner_stall_s += stalled
+        for pkt in out:
+            self.acked_steps += pkt.env_steps
+        self.maybe_emit()
+        return out
+
+    def burst_started(self) -> None:
+        """Claim an EXTRA burst slot (a pipelined learner dispatching more
+        than one unpublished burst); ``take()`` already claims one per
+        non-empty drain, so synchronous learners never call this."""
+        self._burst_seq += 1
+
+    def published(self) -> None:
+        """Release the claim(s): the iteration's params are published (call
+        after ``mirror.refresh`` when training ran, or bare otherwise —
+        once per learner iteration that consumed packets)."""
+        self._pub_seq = self._burst_seq
+
+    @property
+    def queue_len(self) -> int:
+        return len(self._ring)
+
+    # -- telemetry ---------------------------------------------------------
+    def maybe_emit(self, force: bool = False) -> Optional[Dict[str, Any]]:
+        if self.telem is None or not self.enabled:
+            return None
+        now = time.perf_counter()
+        elapsed = now - self._last_emit_t
+        if not force and elapsed < self.stats_every_s:
+            return None
+        with self._stats_lock:
+            busy, pstall, lstall = self._player_busy_s, self._player_stall_s, self._learner_stall_s
+            stale_max = self._staleness_max
+            self._player_busy_s = self._player_stall_s = self._learner_stall_s = 0.0
+            self._staleness_max = 0
+        self._last_emit_t = now
+        denom = busy + pstall
+        rec = {
+            "event": "overlap",
+            "step": int(self.acked_steps),
+            "queue_depth": int(len(self._ring)),
+            "queue_cap": int(self.queue_depth),
+            "packets": int(self.packets_produced),
+            "bursts": int(self._pub_seq),
+            "env_steps_ahead": int(self.produced_steps - self.acked_steps),
+            "player_busy_s": round(busy, 6),
+            "player_stall_s": round(pstall, 6),
+            "learner_stall_s": round(lstall, 6),
+            "player_stall_frac": round(pstall / denom, 6) if denom > 0 else 0.0,
+            "staleness_max": int(stale_max),
+            "interval_s": round(elapsed, 6),
+        }
+        try:
+            self.telem.emit(rec)
+            self._events += 1
+        except Exception:
+            pass
+        return rec
+
+    # -- shutdown ----------------------------------------------------------
+    def shutdown(self, absorb: Optional[Callable[[Packet], None]] = None, timeout: float = 60.0) -> int:
+        """Stop the player, join it, and drain queued packets through
+        ``absorb`` (learner-side buffer apply) so the final checkpoint sees
+        every transition that crossed the queue. Returns the env steps
+        drained. Safe to call twice / when disabled."""
+        if not self.enabled:
+            return 0
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=timeout)
+        drained = 0
+        while True:
+            item = self._ring.try_get()
+            if item is self._ring:
+                break
+            self.acked_steps += item.env_steps
+            if absorb is not None:
+                absorb(item)
+                drained += item.env_steps
+        self.maybe_emit(force=True)
+        return drained

@@ -78,13 +78,24 @@ class ParamMirror:
     step then waits on the transfer); in async mode the newest copy is
     swapped in only once every leaf ``is_ready()``, so the player never
     stalls on the link.
+
+    Thread contract (the overlap engine, ``engine/overlap.py``, relies on
+    it): ``refresh`` is called by the learner thread, ``current`` by the
+    player thread. Both only ever swap whole-pytree references, and the
+    pending-slot handoff is guarded by a tiny lock (uncontended in serial
+    loops; taken once per env step / per burst, never on the device hot
+    path), so a refresh landing mid-swap can never be dropped and the
+    player never sees a half-updated tree.
     """
 
     def __init__(self, params: Any, device: Any, async_refresh: bool = False):
+        import threading
+
         self.device = device
         self.async_refresh = bool(async_refresh)
         self.params = self._put(params)
         self._pending: Optional[Any] = None
+        self._swap_lock = threading.Lock()
 
     def _put(self, params: Any) -> Any:
         """Copy params to the mirror device. ``device_put`` ALIASES an array
@@ -105,18 +116,25 @@ class ParamMirror:
     def refresh(self, params: Any) -> None:
         new = self._put(params)
         if self.async_refresh:
-            self._pending = new
+            with self._swap_lock:
+                self._pending = new
         else:
             self.params = new
 
     def current(self) -> Any:
-        if self._pending is not None:
+        pending = self._pending  # racy peek is fine: the swap below re-checks
+        if pending is not None:
             try:
-                ready = all(x.is_ready() for x in jax.tree.leaves(self._pending))
+                ready = all(x.is_ready() for x in jax.tree.leaves(pending))
             except AttributeError:  # non-Array leaves: treat as ready
                 ready = True
             if ready:
-                self.params, self._pending = self._pending, None
+                # locked swap: a refresh() landing between the peek and here
+                # must not be clobbered with None (it would be lost forever)
+                with self._swap_lock:
+                    self.params = pending
+                    if self._pending is pending:
+                        self._pending = None
         return self.params
 
 

@@ -17,6 +17,14 @@ Like `WallClockStopper`, preemption drain is single-host only: rank-local
 signals cannot coordinate a multi-host stop, and a rank-0-only final save
 would deadlock the collective host conversion on the other hosts. Multi-host
 runs get a stderr note and rely on the periodic checkpoint cadence.
+
+Overlapped loops (`engine/overlap.py`) integrate through the same two
+surfaces: the player thread polls `guard.preempted` from inside the
+engine's queue waits (so it stops feeding as soon as the signal lands,
+even while blocked), and the learner breaks at its own `stop_reached`
+boundary with ``save=False``, drains the queue into the buffer via
+`engine.shutdown`, and lets `close()` write the final (consistent)
+checkpoint.
 """
 from __future__ import annotations
 

@@ -67,6 +67,7 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "device_kind": (False, _STR),
         "wall_capped": (False, bool),
         "mfu": (False, _NUM),
+        "preflight_attempts": (False, _NUM),
     },
     # bench pacing/diagnostic lines (stderr)
     "bench_progress": {
@@ -137,6 +138,22 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "step": (False, _NUM),
         "stalled_s": (False, _NUM),
         "trace_dir": (False, _STR),
+    },
+    # overlapped player/learner engine interval stats (engine/overlap.py):
+    # stall split, queue occupancy and the bounded-staleness high-water mark
+    "overlap": {
+        "step": (True, _NUM),
+        "queue_depth": (False, _NUM),
+        "queue_cap": (False, _NUM),
+        "packets": (False, _NUM),
+        "bursts": (False, _NUM),
+        "env_steps_ahead": (False, _NUM),
+        "player_busy_s": (False, _NUM),
+        "player_stall_s": (False, _NUM),
+        "learner_stall_s": (False, _NUM),
+        "player_stall_frac": (False, _NUM),
+        "staleness_max": (False, _NUM),
+        "interval_s": (False, _NUM),
     },
     # a run restored from a checkpoint (resilience/guard.py)
     "resume": {

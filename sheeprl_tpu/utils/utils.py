@@ -110,6 +110,16 @@ def enable_compilation_cache() -> None:
         pass
 
 
+def acknowledge_partial_donation() -> None:
+    """Donating the replay batch to a scanned train step intentionally
+    includes leaves XLA cannot alias (uint8 frames, tiny flag columns) —
+    the big float leaves DO donate, and jax warns once per compile about
+    the rest. Expected, not actionable: silence exactly that message."""
+    import warnings
+
+    warnings.filterwarnings("ignore", message="Some donated buffers were not usable")
+
+
 def unwrap_fabric(obj: Any) -> Any:  # parity shim; no wrapping exists here
     return obj
 

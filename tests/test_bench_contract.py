@@ -50,6 +50,7 @@ def test_headline_is_e2e_with_step_extra(monkeypatch):
     assert REQUIRED <= rec.keys()
     assert rec["metric"] == "e2e"
     assert rec["extra_metrics"][0]["metric"] == "step"
+    assert rec["preflight_attempts"] == 1  # first probe succeeded
     assert [c[0] for c in calls] == ["preflight", "dv3_step", "dv3"]
 
 
@@ -74,6 +75,8 @@ def test_dead_device_link_falls_back_to_cpu_e2e(monkeypatch):
     assert REQUIRED <= rec.keys()
     assert rec["platform"] == "cpu-fallback"
     assert "preflight" in rec["error"]
+    # CPU fallback only after N real attempts — and the record says so
+    assert rec["preflight_attempts"] == 3
     # the probe retries (flaky relay); the compute-only leg still runs (on
     # the host backend, utilization vs a measured peak — VERDICT r4 item 6)
     assert [c[0] for c in calls] == ["preflight"] * 3 + ["dv3_step", "dv3"]
@@ -87,6 +90,7 @@ def test_forced_cpu_skips_preflight_and_labels_record(monkeypatch):
     rec, calls = _capture_main(monkeypatch, {"dv3": e2e}, force_cpu=True)
     assert rec["platform"] == "cpu-forced"
     assert "BENCH_FORCE_CPU" in rec["error"]
+    assert rec["preflight_attempts"] == 0  # operator skipped the probe
     assert [c[0] for c in calls] == ["dv3_step", "dv3"]  # no preflight probe at all
 
 
@@ -95,4 +99,34 @@ def test_dead_link_and_failed_cpu_fallback_still_prints_json(monkeypatch):
     assert REQUIRED <= rec.keys()
     assert rec["vs_baseline"] == 0.0
     assert "preflight" in rec["error"]  # the tunnel-down cause survives in the record
+    assert rec["preflight_attempts"] == 3
     assert [c[0] for c in calls] == ["preflight"] * 3 + ["dv3_step", "dv3"]
+
+
+def test_hung_preflight_attempt_still_retries(monkeypatch):
+    """A HUNG probe (subprocess timeout, returns None after burning its
+    per-attempt slice) must not consume the whole preflight window --
+    BENCH_r05 fell back after a single hung attempt. Every attempt now gets
+    its own timeout, so all N attempts really run before the fallback."""
+    budgets = []
+    e2e = {"metric": "e2e", "value": 3.0, "unit": "env steps/sec", "vs_baseline": 0.3}
+
+    def fake_run(argv, budget):
+        budgets.append((argv[0], budget))
+        return e2e if argv[0] == "dv3" else None  # every probe "hangs" (None)
+
+    monkeypatch.setattr(bench, "_run_subprocess_record", fake_run)
+    monkeypatch.setenv("SHEEPRL_TPU_PROGRESS", "0")
+    monkeypatch.setenv("BENCH_PREFLIGHT_RETRY_PAUSE_S", "0")
+    monkeypatch.setenv("BENCH_PREFLIGHT_BUDGET_S", "90")
+    monkeypatch.delenv("BENCH_PREFLIGHT_ATTEMPT_S", raising=False)
+    monkeypatch.delenv("BENCH_FORCE_CPU", raising=False)
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    bench.main()
+    sys.stdout = sys.__stdout__
+    rec = json.loads([ln for ln in out.getvalue().strip().splitlines() if ln.strip()][-1])
+    probes = [b for a, b in budgets if a == "preflight"]
+    assert len(probes) == 3  # a hung attempt no longer eats the retries
+    assert all(b <= 90 / 3 + 1e-6 for b in probes)  # per-attempt timeout slice
+    assert rec["preflight_attempts"] == 3 and rec["platform"] == "cpu-fallback"

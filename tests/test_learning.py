@@ -77,18 +77,20 @@ def test_dreamer_v3_world_model_optimizes():
             c0 = rng.integers(0, 200)
             for t in range(T):
                 rgb[g, t, b] = (c0 + t) % 256  # the dummy env's dynamic
-    fixed = {
-        "rgb": jnp.asarray(rgb),
-        "actions": jnp.asarray(np.eye(N_ACT, dtype=np.float32)[rng.integers(0, N_ACT, (G, T, B))]),
-        "rewards": jnp.zeros((G, T, B, 1), jnp.float32),
-        "terminated": jnp.zeros((G, T, B, 1), jnp.float32),
-        "truncated": jnp.zeros((G, T, B, 1), jnp.float32),
-        "is_first": jnp.zeros((G, T, B, 1), jnp.float32),
+    fixed_host = {
+        "rgb": rgb,
+        "actions": np.eye(N_ACT, dtype=np.float32)[rng.integers(0, N_ACT, (G, T, B))],
+        "rewards": np.zeros((G, T, B, 1), np.float32),
+        "terminated": np.zeros((G, T, B, 1), np.float32),
+        "truncated": np.zeros((G, T, B, 1), np.float32),
+        "is_first": np.zeros((G, T, B, 1), np.float32),
     }
     key = jax.random.key(1)
     losses = []
     for _ in range(8):
         key, k = jax.random.split(key)
+        # fresh device arrays every burst: train donates its batch buffers
+        fixed = {k2: jnp.asarray(v) for k2, v in fixed_host.items()}
         params, opt_states, moments, m = train(
             params, opt_states, moments, fixed, jax.random.split(k, G)
         )
