@@ -119,6 +119,18 @@ def _timed_cli_run(args: list, steps: int, baseline_seconds: float, baseline_ste
         rec["startup_seconds"] = round(steady_t - t0, 2)  # env init + compile + first burst
     if steps_done < steps:
         rec["wall_capped"] = True
+    try:
+        # same basis stamp as bench_dv3.record(): the e2e record labels its
+        # own MFU denominator class (vendor peak vs measured host matmul)
+        # even when the compute-only leg never ran to copy it from — the
+        # label alone, no matmul measurement
+        import jax
+
+        from sheeprl_tpu.telemetry.throughput import peak_flops_basis_for
+
+        rec["peak_flops_basis"] = peak_flops_basis_for(jax.devices()[0])
+    except Exception:
+        pass
     return rec
 
 

@@ -208,14 +208,17 @@ def record() -> dict:
         "device_kind": getattr(jax.devices()[0], "device_kind", ""),
         "precision": str(cfg.fabric.precision),
     }
-    if flops_per_step is not None:
-        from sheeprl_tpu.telemetry.throughput import mfu as _mfu
-        from sheeprl_tpu.telemetry.throughput import peak_flops_record
+    # the basis label is stamped UNCONDITIONALLY (vendor table / measured
+    # host matmul / unknown): every record names its MFU denominator class
+    # even when cost analysis yielded no model FLOPs and mfu is omitted —
+    # the measurement itself only runs when there are FLOPs to divide
+    from sheeprl_tpu.telemetry.throughput import mfu as _mfu
+    from sheeprl_tpu.telemetry.throughput import peak_flops_basis_for, peak_flops_record
 
+    rec["peak_flops_basis"] = peak_flops_basis_for(jax.devices()[0])
+    if flops_per_step is not None:
         rec["model_flops_per_step"] = flops_per_step
-        peak_rec = peak_flops_record(jax.devices()[0])
-        rec["peak_flops_basis"] = peak_rec["peak_flops_basis"]
-        peak = peak_rec["peak_flops"]
+        peak = peak_flops_record(jax.devices()[0])["peak_flops"]
         if peak is not None:
             # flops_per_step and sps are whole-mesh quantities; normalize the
             # peak by the device count so multi-chip runs report true MFU

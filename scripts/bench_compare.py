@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare the newest BENCH_*.json / MULTICHIP_*.json
+against the recorded trajectory and exit nonzero on a real regression.
+
+The repo accumulates one `BENCH_rNN.json` (+ `MULTICHIP_rNN.json`) per
+round, but until now nothing ever *compared* them — a 20% steady-state SPS
+slide would merge silently. This script is the gate: runnable standalone, in
+CI (`scripts/lint.sh`), and from `sheeprl_tpu doctor bench_dir=...`.
+
+Comparison rules (normalization — the trajectory is heterogeneous):
+
+* records are grouped by **unit + platform class** (`cpu` / `cpu-fallback` /
+  `cpu-forced` are one class, accelerator platforms another): a CPU-fallback
+  round is never judged against a TPU round, and the compute-only
+  steps/s metric is never judged against the end-to-end env-steps/sec one;
+* rounds that produced no parsed record or exited nonzero (e.g. the rc=124
+  timeout round) are *excluded from the baseline*, not treated as zeros;
+* `wall_capped` runs are comparable on `steady_state_sps` (startup excluded
+  by construction) and on the headline SPS (a rate, not a total);
+  `preflight_attempts` only documents *why* a record's platform class is
+  what it is — the class grouping is the actual normalizer;
+* the newest record must keep `value` (headline SPS), `steady_state_sps`
+  and `mfu` — each compared only when BOTH sides carry it — within
+  ``(1 - threshold)`` of the best comparable prior record;
+* `MULTICHIP_*.json`: the newest record must not flip `ok` to false when
+  any prior round passed.
+
+``--dry-run`` performs the full comparison and prints the report but always
+exits 0 unless the artifacts themselves are unreadable — that keeps the
+lint entry point honest (a rotten gate fails loudly) without letting a
+genuinely slower machine block unrelated CI.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+ROUND_RE = re.compile(r"_r(\d+)\.json$")
+CPU_CLASS = {"cpu", "cpu-fallback", "cpu-forced"}
+
+# the (field, pretty-name) pairs gated for regressions, most important first
+GATED_FIELDS = (("steady_state_sps", "steady-state SPS"), ("value", "headline SPS"), ("mfu", "MFU"))
+
+
+def _round_of(path: Path) -> int:
+    m = ROUND_RE.search(path.name)
+    return int(m.group(1)) if m else -1
+
+
+def platform_class(rec: Dict[str, Any]) -> str:
+    plat = str(rec.get("platform") or "unknown").lower()
+    return "cpu" if plat in CPU_CLASS else plat
+
+
+def load_trajectory(bench_dir: Any) -> List[Dict[str, Any]]:
+    """All readable BENCH_*.json records, oldest round first. Each returned
+    dict is the *parsed* headline record plus bookkeeping (`_round`, `_file`,
+    `_rc`, `_usable`)."""
+    bench_dir = Path(bench_dir)
+    out: List[Dict[str, Any]] = []
+    for path in sorted(bench_dir.glob("BENCH_*.json"), key=_round_of):
+        try:
+            wrapper = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as err:
+            raise RuntimeError(f"unreadable bench artifact {path}: {err}")
+        parsed = wrapper.get("parsed") if isinstance(wrapper, dict) else None
+        rec = dict(parsed) if isinstance(parsed, dict) else {}
+        rec["_round"] = _round_of(path)
+        rec["_file"] = path.name
+        rec["_rc"] = wrapper.get("rc") if isinstance(wrapper, dict) else None
+        # a failed round (timeout, crash) is excluded from baselines — it
+        # documents an infra failure, not a performance level
+        rec["_usable"] = bool(parsed) and wrapper.get("rc") == 0 and rec.get("value") is not None
+        out.append(rec)
+    return out
+
+
+def load_multichip(bench_dir: Any) -> List[Dict[str, Any]]:
+    bench_dir = Path(bench_dir)
+    out = []
+    for path in sorted(bench_dir.glob("MULTICHIP_*.json"), key=_round_of):
+        try:
+            wrapper = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as err:
+            raise RuntimeError(f"unreadable multichip artifact {path}: {err}")
+        wrapper["_round"] = _round_of(path)
+        wrapper["_file"] = path.name
+        out.append(wrapper)
+    return out
+
+
+def _comparable(newest: Dict[str, Any], prior: Dict[str, Any]) -> bool:
+    return (
+        prior["_usable"]
+        and prior.get("unit") == newest.get("unit")
+        and platform_class(prior) == platform_class(newest)
+    )
+
+
+def compare(
+    records: List[Dict[str, Any]],
+    threshold: float = 0.2,
+    multichip: Optional[List[Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """Gate the newest usable record against the best comparable prior one.
+    Returns {ok, failures[], comparisons[], note?}."""
+    report: Dict[str, Any] = {"ok": True, "failures": [], "comparisons": [], "threshold": threshold}
+    usable = [r for r in records if r["_usable"]]
+    if records and not records[-1]["_usable"]:
+        # prior crashed rounds are merely excluded from the baseline, but the
+        # NEWEST round producing no data is itself the regression the gate
+        # exists to catch — "bench stopped working" must not go green
+        report["ok"] = False
+        report["failures"].append(
+            f"newest bench round {records[-1]['_file']} produced no usable record "
+            f"(rc={records[-1]['_rc']}) — the benchmark itself is broken or timed out"
+        )
+    if not usable:
+        report["note"] = "no usable bench records in the trajectory"
+    else:
+        newest = usable[-1]
+        priors = [r for r in usable[:-1] if _comparable(newest, r)]
+        report["newest"] = {
+            "file": newest["_file"],
+            "platform": newest.get("platform"),
+            "platform_class": platform_class(newest),
+            "unit": newest.get("unit"),
+            "wall_capped": newest.get("wall_capped"),
+            "preflight_attempts": newest.get("preflight_attempts"),
+        }
+        if not priors:
+            report["note"] = (
+                f"no comparable prior record (unit={newest.get('unit')!r}, "
+                f"platform class={platform_class(newest)!r}) — nothing to gate against"
+            )
+        for key, label in GATED_FIELDS:
+            new_val = newest.get(key)
+            baseline = max(
+                (float(r[key]) for r in priors if r.get(key) is not None), default=None
+            )
+            cmp: Dict[str, Any] = {"metric": key, "newest": new_val, "baseline_best": baseline}
+            if new_val is None or baseline is None or baseline <= 0:
+                cmp["verdict"] = "skipped (missing on one side)"
+            else:
+                ratio = float(new_val) / baseline
+                cmp["ratio"] = round(ratio, 4)
+                # a drop of exactly the threshold counts as a regression
+                if 1.0 - ratio >= threshold - 1e-9:
+                    cmp["verdict"] = "REGRESSION"
+                    report["ok"] = False
+                    report["failures"].append(
+                        f"{label} regressed {1 - ratio:.0%}: {new_val} vs best prior "
+                        f"{baseline} ({newest['_file']}, threshold {threshold:.0%})"
+                    )
+                else:
+                    cmp["verdict"] = "ok"
+            report["comparisons"].append(cmp)
+
+    # the multichip gate runs even with no (usable) BENCH records — a
+    # MULTICHIP-only trajectory still has an ok→fail flip to catch
+
+    if multichip:
+        newest_mc = multichip[-1]
+        prior_ok = any(m.get("ok") for m in multichip[:-1])
+        cmp = {"metric": "multichip_ok", "newest": newest_mc.get("ok"), "baseline_best": prior_ok}
+        if prior_ok and not newest_mc.get("ok"):
+            cmp["verdict"] = "REGRESSION"
+            report["ok"] = False
+            report["failures"].append(
+                f"multichip dryrun flipped to failing ({newest_mc['_file']}) "
+                "after passing in a prior round"
+            )
+        else:
+            cmp["verdict"] = "ok" if newest_mc.get("ok") else "skipped (never passed)"
+        report["comparisons"].append(cmp)
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=str(Path(__file__).resolve().parent.parent),
+                    help="directory holding BENCH_*.json / MULTICHIP_*.json (default: repo root)")
+    ap.add_argument("--threshold", type=float, default=0.2,
+                    help="allowed fractional drop vs the best comparable prior record")
+    ap.add_argument("--json", action="store_true", help="print the report as JSON")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="full comparison + report, but exit 0 even on regression "
+                         "(artifact read errors still exit 1)")
+    args = ap.parse_args(argv)
+
+    try:
+        records = load_trajectory(args.dir)
+        multichip = load_multichip(args.dir)
+    except RuntimeError as err:
+        print(f"[bench_compare] {err}", file=sys.stderr)
+        return 1
+    if not records and not multichip:
+        print(f"[bench_compare] no BENCH_*.json under {args.dir}; nothing to gate", file=sys.stderr)
+        return 0
+    report = compare(records, threshold=args.threshold, multichip=multichip)
+
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(f"bench gate over {len(records)} BENCH + {len(multichip)} MULTICHIP records "
+              f"(threshold {args.threshold:.0%})")
+        if report.get("note"):
+            print(f"  note: {report['note']}")
+        if report.get("newest"):
+            n = report["newest"]
+            print(f"  newest: {n['file']} unit={n['unit']!r} platform_class={n['platform_class']}")
+        for cmp in report["comparisons"]:
+            print(f"  {cmp['metric']}: newest={cmp['newest']} baseline_best={cmp['baseline_best']} "
+                  f"-> {cmp['verdict']}")
+        print(f"  verdict: {'OK' if report['ok'] else 'REGRESSION'}")
+        for failure in report["failures"]:
+            print(f"  !! {failure}")
+    if not report["ok"] and not args.dry_run:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Single lint/gate entry point, wired into tier-1 (tests/test_lint.py) so
+# neither check can silently rot:
+#   * scripts/check_host_sync.py — the AST lint against hidden device→host
+#     syncs in the training hot loops;
+#   * scripts/bench_compare.py --dry-run — the bench regression gate run
+#     over the repo's recorded BENCH_*/MULTICHIP_* trajectory (full
+#     comparison + report; --dry-run keeps a slower CI host from failing
+#     unrelated changes, while unreadable/rotten artifacts still fail).
+# CI that wants the gate to BLOCK on regression runs bench_compare without
+# --dry-run instead.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python scripts/check_host_sync.py
+python scripts/bench_compare.py --dry-run

@@ -281,6 +281,22 @@ def resume(args: Optional[Sequence[str]] = None) -> None:
     resume_run(run_dir, rest, force=force)
 
 
+def doctor(args: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl_tpu doctor run_dir=<logs/runs/.../version_N> [json=true]
+    [strict=true] [bench_dir=<dir>]` — triage a slow or dead run in seconds:
+    reconstructs the timeline from the (rotated) telemetry JSONL stream, the
+    resume manifest and the checkpoint dir, runs the rule-based detectors
+    (retrace storms, overlap queue starvation, checkpoint-write spikes,
+    in-run SPS/MFU decay, watchdog/preemption incidents) and prints a ranked
+    report with remediation hints (diag/doctor.py)."""
+    argv = list(args if args is not None else sys.argv[1:])
+    from .diag.doctor import main as doctor_main
+
+    rc = doctor_main(argv)
+    if rc:
+        raise SystemExit(rc)
+
+
 def registration(args: Optional[Sequence[str]] = None) -> None:
     """`sheeprl_tpu registration checkpoint_path=... [backend=mlflow]` —
     register a trained model, split per the algo's MODELS_TO_REGISTER
@@ -335,9 +351,11 @@ def available_agents() -> None:
 
 
 def main() -> None:
-    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|registration|agents> ...`"""
+    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|doctor|registration|agents> ...`"""
     argv = sys.argv[1:]
-    if argv and argv[0] in ("run", "eval", "evaluation", "resume", "serve", "registration", "agents"):
+    if argv and argv[0] in (
+        "run", "eval", "evaluation", "resume", "serve", "doctor", "registration", "agents"
+    ):
         cmd, rest = argv[0], argv[1:]
     else:
         cmd, rest = "run", argv
@@ -349,6 +367,8 @@ def main() -> None:
         resume(rest)
     elif cmd == "serve":
         serve(rest)
+    elif cmd == "doctor":
+        doctor(rest)
     elif cmd == "registration":
         registration(rest)
     elif cmd == "agents":

@@ -1,0 +1,36 @@
+"""Run diagnostics: the consumption side of telemetry.
+
+PR 1–4 made every subsystem *emit* schema-validated JSONL events; this
+package *consumes* them:
+
+* :mod:`.timeline` — streaming reader over (rotated) ``telemetry.jsonl``
+  reconstructing a run's per-step timeline;
+* :mod:`.findings` — rule-based detectors producing ranked
+  :class:`~sheeprl_tpu.diag.findings.Finding`\\ s with remediation hints;
+* :mod:`.doctor` — the ``sheeprl_tpu doctor run_dir=...`` CLI (text and
+  ``--json`` reports over stream + resume manifest + checkpoint dir);
+* :mod:`.prometheus` — a lock-light counter/gauge/histogram registry with a
+  stdlib-HTTP ``/metrics`` endpoint (Prometheus text format), mirrored from
+  the live event stream by the Telemetry facade and reused by the policy
+  server's serving histograms.
+"""
+from .findings import Finding, run_detectors
+from .doctor import diagnose, render_text
+from .prometheus import Counter, Gauge, Histogram, PrometheusServer, Registry, start_http_server
+from .timeline import Timeline, iter_events, rotated_segments
+
+__all__ = [
+    "Counter",
+    "Finding",
+    "Gauge",
+    "Histogram",
+    "PrometheusServer",
+    "Registry",
+    "Timeline",
+    "diagnose",
+    "iter_events",
+    "render_text",
+    "rotated_segments",
+    "run_detectors",
+    "start_http_server",
+]

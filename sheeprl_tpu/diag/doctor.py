@@ -1,0 +1,272 @@
+"""`sheeprl_tpu doctor run_dir=...` — triage a run in seconds.
+
+Reads everything a run leaves behind — the (rotated) telemetry JSONL stream,
+the resume manifest and the checkpoint directory — reconstructs the timeline,
+runs the rule-based detectors and prints a ranked report with remediation
+hints. `--json` (or `json=true`) emits the same report as one JSON object
+for dashboards/CI.
+
+Optional: `bench_dir=<dir>` also runs the bench regression gate
+(`scripts/bench_compare.py`) over that directory's `BENCH_*.json` /
+`MULTICHIP_*.json` trajectory and folds the comparison into the report.
+
+Exit code: 0 by default; with `strict=true` (CI mode) a critical finding or
+a failed bench gate exits 1.
+"""
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .findings import Finding, run_detectors
+from .timeline import Timeline, rotated_segments
+
+__all__ = ["diagnose", "render_text", "main"]
+
+_SEV_GLYPH = {"critical": "[CRIT]", "warning": "[WARN]", "info": "[info]"}
+
+
+def _load_diag_cfg(cfg: Any = None) -> Any:
+    """Ensure a config with a `diag` node: the caller's (run) config when it
+    has one, else the packaged `configs/diag/default.yaml` defaults."""
+    if cfg is not None and hasattr(cfg, "select") and cfg.select("diag") is not None:
+        return cfg
+    try:
+        from ..config import Config, load_config_file
+        from ..config.compose import CONFIG_ROOT
+
+        node = load_config_file(CONFIG_ROOT / "diag" / "default.yaml")
+        return Config({"diag": node.to_dict() if hasattr(node, "to_dict") else dict(node)})
+    except Exception:
+        return cfg
+
+
+def _resolve_log_dir(run_dir: Path) -> Path:
+    """Accept a version_N log dir, the run base dir above it, or any dir that
+    directly holds a telemetry.jsonl (synthetic fixtures, copied logs)."""
+    run_dir = Path(run_dir)
+    if (run_dir / "telemetry.jsonl").is_file() or rotated_segments(run_dir / "telemetry.jsonl"):
+        return run_dir
+    try:
+        from ..resilience.resume import resolve_version_dir
+
+        return resolve_version_dir(run_dir)
+    except FileNotFoundError:
+        return run_dir
+
+
+def _ckpt_summary(log_dir: Path) -> Dict[str, Any]:
+    try:
+        from ..utils.checkpoint import CheckpointManager
+
+        ckpts = CheckpointManager(str(log_dir), enabled=False).list_checkpoints()
+    except Exception:
+        ckpts = []
+    out: Dict[str, Any] = {"count": len(ckpts)}
+    if ckpts:
+        out["newest"] = str(ckpts[-1])
+        try:
+            out["newest_step"] = int(ckpts[-1].stem.split("_")[1])
+        except (IndexError, ValueError):
+            pass
+    return out
+
+
+def _throughput_summary(tl: Timeline) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    sps = [v for _, v in tl.sps_series()]
+    if sps:
+        out["sps_median"] = round(statistics.median(sps), 4)
+        out["sps_last"] = round(sps[-1], 4)
+        if len(sps) > 1:  # steady-state: skip the compile/warmup interval
+            out["sps_steady_median"] = round(statistics.median(sps[1:]), 4)
+    mfu = [v for _, v in tl.mfu_series()]
+    if mfu:
+        out["mfu_last"] = round(mfu[-1], 4)
+    return out
+
+
+def diagnose(
+    run_dir: Any, cfg: Any = None, bench_dir: Optional[Any] = None
+) -> Dict[str, Any]:
+    """Build the full doctor report for one run directory."""
+    log_dir = _resolve_log_dir(Path(run_dir))
+    if cfg is None and (log_dir / "config.yaml").is_file():
+        # the run's SAVED config carries any per-run diag threshold
+        # overrides (the `diag` group composes into every run config)
+        try:
+            from ..config import load_config_file
+
+            cfg = load_config_file(log_dir / "config.yaml")
+        except Exception:
+            cfg = None
+    cfg = _load_diag_cfg(cfg)
+    stream = log_dir / "telemetry.jsonl"
+    segments = rotated_segments(stream)
+    if not segments:
+        raise FileNotFoundError(
+            f"No telemetry stream under {log_dir} (expected {stream} or rotated "
+            "segments; was the run started with metric.telemetry.jsonl=True?)"
+        )
+    tl = Timeline.from_path(stream)
+    findings = run_detectors(tl, cfg)
+
+    from ..resilience.resume import read_manifest
+
+    report: Dict[str, Any] = {
+        "run_dir": str(run_dir),
+        "log_dir": str(log_dir),
+        "stream_segments": [str(p) for p in segments],
+        "events": dict(sorted(tl.counts.items())),
+        "parse_errors": len(tl.parse_errors),
+        "startup": tl.startup,
+        "last_step": tl.last_step,
+        "clean_shutdown": tl.shutdown is not None,
+        "throughput": _throughput_summary(tl),
+        "manifest": read_manifest(log_dir),
+        "checkpoints": _ckpt_summary(log_dir),
+        "findings": [f.to_dict() for f in findings],
+        "healthy": not any(f.severity == "critical" for f in findings),
+    }
+    if bench_dir is not None:
+        report["bench"] = _bench_report(Path(bench_dir), cfg)
+        if report["bench"] and not report["bench"].get("ok", True):
+            report["healthy"] = False
+    return report
+
+
+def _bench_report(bench_dir: Path, cfg: Any) -> Optional[Dict[str, Any]]:
+    """Fold the bench regression gate into the report (scripts/bench_compare)."""
+    import importlib.util
+
+    script = Path(__file__).resolve().parents[2] / "scripts" / "bench_compare.py"
+    if not script.is_file():
+        return {"ok": True, "note": f"bench_compare not found at {script}"}
+    spec = importlib.util.spec_from_file_location("bench_compare", script)
+    mod = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(mod)  # type: ignore[union-attr]
+        threshold = None
+        if cfg is not None and hasattr(cfg, "select"):
+            threshold = cfg.select("diag.bench.threshold")
+        records = mod.load_trajectory(bench_dir)
+        multichip = mod.load_multichip(bench_dir)
+    except Exception as err:
+        # a half-written/corrupt artifact must not cost the user the whole
+        # run diagnosis — report it as a failed gate instead of a traceback
+        return {"ok": False, "failures": [f"bench artifacts unreadable: {err}"]}
+    if not records and not multichip:
+        return {"ok": True, "note": f"no BENCH_*.json under {bench_dir}"}
+    return mod.compare(
+        records,
+        threshold=float(threshold) if threshold is not None else 0.2,
+        multichip=multichip,
+    )
+
+
+# -- rendering ---------------------------------------------------------------
+def render_text(report: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    startup = report.get("startup") or {}
+    head = (
+        f"doctor report — {report['log_dir']}\n"
+        f"  algo={startup.get('algo') or '?'} platform={startup.get('platform') or '?'} "
+        f"device_kind={startup.get('device_kind') or '?'} devices={startup.get('devices') or '?'}"
+    )
+    lines.append(head)
+    tp = report.get("throughput") or {}
+    lines.append(
+        f"  last step {report['last_step']}; "
+        + (
+            f"steady SPS {tp['sps_steady_median']}"
+            if "sps_steady_median" in tp
+            else f"median SPS {tp.get('sps_median', 'n/a')}"
+        )
+        + (f"; MFU {tp['mfu_last']}" if "mfu_last" in tp else "")
+        + ("; clean shutdown" if report.get("clean_shutdown") else "; NO shutdown event")
+    )
+    ckpt = report.get("checkpoints") or {}
+    manifest = report.get("manifest") or {}
+    lines.append(
+        f"  checkpoints: {ckpt.get('count', 0)}"
+        + (f", newest @ step {ckpt['newest_step']}" if "newest_step" in ckpt else "")
+        + (f"; manifest @ step {manifest['step']}" if manifest.get("step") is not None else "; no manifest")
+    )
+    if len(report.get("stream_segments", [])) > 1:
+        lines.append(f"  stream: {len(report['stream_segments'])} rotated segment(s) read in order")
+    if report.get("parse_errors"):
+        lines.append(f"  {report['parse_errors']} unparseable line(s) skipped (torn tail?)")
+
+    findings = report.get("findings") or []
+    if not findings:
+        lines.append("\nNo findings — the run looks healthy.")
+    else:
+        lines.append(f"\n{len(findings)} finding(s), most severe first:")
+        for i, f in enumerate(findings, 1):
+            glyph = _SEV_GLYPH.get(f["severity"], f"[{f['severity']}]")
+            lines.append(f"\n{i}. {glyph} {f['title']}  (steps {f['step_first']}–{f['step_last']})")
+            lines.append(f"   {f['detail']}")
+            lines.append(f"   fix: {f['remediation']}")
+
+    bench = report.get("bench")
+    if bench is not None:
+        ok = bench.get("ok", True)
+        lines.append(
+            f"\nbench gate: {'OK' if ok else 'REGRESSION'}"
+            + (f" — {bench.get('note')}" if bench.get("note") else "")
+        )
+        for failure in bench.get("failures", []):
+            lines.append(f"   [CRIT] {failure}")
+    lines.append("\nverdict: " + ("HEALTHY" if report.get("healthy") else "NEEDS ATTENTION"))
+    return "\n".join(lines)
+
+
+# -- CLI ---------------------------------------------------------------------
+def parse_doctor_argv(argv: Sequence[str]) -> Tuple[str, Dict[str, Any]]:
+    import yaml
+
+    run_dir: Optional[str] = None
+    opts: Dict[str, Any] = {"json": False, "strict": False, "bench_dir": None}
+    for a in argv:
+        if a == "--json":
+            opts["json"] = True
+        elif a == "--strict":
+            opts["strict"] = True
+        elif a.startswith("run_dir="):
+            run_dir = a.split("=", 1)[1]
+        elif a.startswith("json="):
+            opts["json"] = bool(yaml.safe_load(a.split("=", 1)[1]))
+        elif a.startswith("strict="):
+            opts["strict"] = bool(yaml.safe_load(a.split("=", 1)[1]))
+        elif a.startswith("bench_dir="):
+            opts["bench_dir"] = a.split("=", 1)[1]
+        elif run_dir is None and "=" not in a:
+            run_dir = a  # bare positional path is accepted too
+        else:
+            raise ValueError(f"Unknown doctor argument '{a}'")
+    if run_dir is None:
+        raise ValueError(
+            "doctor requires `run_dir=<logs/runs/.../version_N>` (a run log dir, "
+            "its parent run dir, or any dir holding a telemetry.jsonl)"
+        )
+    return run_dir, opts
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(argv if argv is not None else sys.argv[1:])
+    run_dir, opts = parse_doctor_argv(argv)
+    report = diagnose(run_dir, bench_dir=opts["bench_dir"])
+    if opts["json"]:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print(render_text(report))
+    if opts["strict"] and not report.get("healthy", False):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

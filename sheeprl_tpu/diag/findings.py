@@ -1,0 +1,347 @@
+"""Flight-recorder analysis, part 2: rule-based detectors → ranked Findings.
+
+Each detector reads the reconstructed :class:`~sheeprl_tpu.diag.timeline.Timeline`
+and emits zero or more :class:`Finding`s — a diagnosis with a severity, the
+evidence that triggered it, and a concrete remediation hint. The rules are
+deliberately simple threshold checks over the derived series; they encode
+the triage the humans on this repo have been doing by hand over raw JSONL
+(retrace storms, overlap queue starvation, checkpoint write spikes,
+within-run throughput/MFU decay, watchdog and preemption incidents).
+
+Thresholds come from ``configs/diag/default.yaml`` so a fleet can tune them
+without code changes; every detector works with the defaults when no config
+is supplied.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .timeline import Timeline
+
+__all__ = ["Finding", "run_detectors", "DETECTORS", "SEVERITY_ORDER"]
+
+SEVERITY_ORDER = {"critical": 0, "warning": 1, "info": 2}
+
+
+@dataclass
+class Finding:
+    """One diagnosis: what happened, the evidence, and what to do about it."""
+
+    code: str
+    severity: str  # critical | warning | info
+    title: str
+    detail: str
+    remediation: str
+    step_first: int = 0
+    step_last: int = 0
+    data: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "code": self.code,
+            "severity": self.severity,
+            "title": self.title,
+            "detail": self.detail,
+            "remediation": self.remediation,
+            "step_first": int(self.step_first),
+            "step_last": int(self.step_last),
+            "data": self.data,
+        }
+
+
+def _sel(cfg: Any, path: str, default: Any) -> Any:
+    if cfg is None:
+        return default
+    if hasattr(cfg, "select"):
+        val = cfg.select(path, default)
+        return default if val is None else val
+    node: Any = cfg
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return default
+        node = node[part]
+    return default if node is None else node
+
+
+# -- detectors ---------------------------------------------------------------
+def detect_retrace_storm(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Recompiles after warmup: each one stalls the device for seconds and a
+    storm (every step a new shape) can silently 10x a run's wall clock. The
+    RetraceDetector's shape-change attribution names the function and the
+    exact arg that changed shape/dtype — surface it verbatim."""
+    min_retraces = int(_sel(cfg, "diag.retrace.min_retraces", 4))
+    total = tl.total_retraces()
+    if total < min_retraces:
+        return []
+    steps = [s for s, r, _ in tl.retrace_intervals() if r > 0]
+    attribution = tl.retrace_attribution()
+    attr_note = "; ".join(attribution[:3]) if attribution else "no attribution captured"
+    return [
+        Finding(
+            code="retrace_storm",
+            severity="critical",
+            title=f"retrace storm: {total} retraces after warmup",
+            detail=(
+                f"{total} XLA retraces accumulated across the run "
+                f"(first at step {steps[0] if steps else 0}). "
+                f"Attribution: {attr_note}"
+            ),
+            remediation=(
+                "A changing input shape/dtype recompiles the whole program every time. "
+                "Pad or bucket the offending argument to a fixed shape (see the "
+                "attribution above for which one moved), hoist python scalars into "
+                "traced arrays, and re-check with `metric.telemetry` retrace counters. "
+                "howto/tpu_performance.md covers shape bucketing."
+            ),
+            step_first=steps[0] if steps else 0,
+            step_last=steps[-1] if steps else 0,
+            data={"retraces": total, "attribution": attribution[:10]},
+        )
+    ]
+
+
+def detect_overlap_starvation(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Player stall fraction high-water: the env thread spends its interval
+    parked on a full queue or the staleness gate — the learner is the
+    bottleneck and the overlap win is gone."""
+    threshold = float(_sel(cfg, "diag.overlap.stall_frac", 0.5))
+    min_events = int(_sel(cfg, "diag.overlap.min_events", 2))
+    stalls = tl.overlap_stalls()
+    hot = [(s, f) for s, f in stalls if f >= threshold]
+    if len(hot) < min_events:
+        return []
+    high_step, high = max(hot, key=lambda x: x[1])
+    return [
+        Finding(
+            code="overlap_starvation",
+            severity="warning",
+            title=(
+                f"overlap queue starvation: player stalled {high:.0%} of an interval "
+                f"({len(hot)}/{len(stalls)} intervals over {threshold:.0%})"
+            ),
+            detail=(
+                f"player_stall_frac high-water {high:.3f} at step {high_step}; the player "
+                f"spent most of those intervals blocked on the bounded queue / staleness "
+                "gate instead of stepping envs."
+            ),
+            remediation=(
+                "The learner can't keep up with collection. Raise "
+                "`algo.overlap.queue_depth` (more buffering) or "
+                "`algo.overlap.staleness_bound` (if the algorithm tolerates staler "
+                "params), shrink the per-burst train cost (batch size, replay ratio), "
+                "or accept that the device is the bottleneck — check Time/train_time "
+                "vs Time/env_interaction_time spans in the same intervals."
+            ),
+            step_first=hot[0][0],
+            step_last=hot[-1][0],
+            data={"stall_frac_max": high, "intervals_over_threshold": len(hot), "intervals": len(stalls)},
+        )
+    ]
+
+
+def detect_ckpt_spikes(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Checkpoint saves blocking the train thread: block_ms is the part the
+    step loop actually pays (device→host snapshot with the async writer, the
+    whole durable write when sync)."""
+    threshold_ms = float(_sel(cfg, "diag.ckpt.block_ms", 1000.0))
+    blocks = tl.ckpt_blocks()
+    hot = [(s, b) for s, b in blocks if b >= threshold_ms]
+    if not hot:
+        return []
+    worst_step, worst = max(hot, key=lambda x: x[1])
+    modes = {rec.get("mode") for rec in tl.of("ckpt_async") if rec.get("mode")}
+    sync_note = " Writes ran SYNCHRONOUSLY (mode=sync)." if modes == {"sync"} else ""
+    return [
+        Finding(
+            code="ckpt_spike",
+            severity="warning",
+            title=f"checkpoint writes block the train thread ({worst:.0f} ms worst)",
+            detail=(
+                f"{len(hot)}/{len(blocks)} checkpoint saves blocked the train thread for "
+                f">= {threshold_ms:.0f} ms (worst {worst:.0f} ms at step {worst_step})."
+                + sync_note
+            ),
+            remediation=(
+                "Enable the async writer (`resilience.async_checkpoint.enabled=True`) so "
+                "the loop only pays the device→host snapshot; for big replay buffers turn "
+                "on `buffer.memmap_fast_resume=True` (checkpoints reference the memmap "
+                "instead of copying it); raise `checkpoint.every` if the cadence itself "
+                "is too hot."
+            ),
+            step_first=hot[0][0],
+            step_last=hot[-1][0],
+            data={"block_ms_max": worst, "saves_over_threshold": len(hot), "saves": len(blocks)},
+        )
+    ]
+
+
+def detect_throughput_degradation(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Within-run decay of steady-state SPS (and MFU): compare the early
+    steady window against the latest window, after dropping the first
+    interval (compile + warmup). A slow leak here is how fragmenting hosts,
+    growing buffers and creeping retraces show up before anything crashes."""
+    drop_frac = float(_sel(cfg, "diag.throughput.drop_frac", 0.2))
+    min_intervals = int(_sel(cfg, "diag.throughput.min_intervals", 4))
+    out: List[Finding] = []
+    for name, series, unit in (
+        ("sps", tl.sps_series(), "steps/s"),
+        ("mfu", tl.mfu_series(), ""),
+    ):
+        if len(series) < min_intervals + 1:
+            continue
+        steady = series[1:]  # drop the compile/warmup interval
+        window = max(1, len(steady) // 4)
+        early = sorted(v for _, v in steady[:window])[len(steady[:window]) // 2]
+        late_vals = sorted(v for _, v in steady[-window:])
+        late = late_vals[len(late_vals) // 2]
+        if early <= 0 or late >= early * (1.0 - drop_frac):
+            continue
+        drop = 1.0 - late / early
+        out.append(
+            Finding(
+                code=f"{name}_degradation",
+                severity="warning",
+                title=f"steady-state {name.upper()} degraded {drop:.0%} within the run",
+                detail=(
+                    f"median {name} fell from {early:.4g}{unit and ' ' + unit} (early steady window) "
+                    f"to {late:.4g}{unit and ' ' + unit} (final window) — a {drop:.0%} in-run decay, "
+                    f"over threshold {drop_frac:.0%}."
+                ),
+                remediation=(
+                    "Check the same intervals for rising XLA/retraces (storm), rising "
+                    "Memory/bytes_in_use (fragmentation / buffer growth), ckpt_async "
+                    "block_ms spikes, and overlap player_stall_frac. If none move, the "
+                    "envs themselves are slowing down (episode length drift, host "
+                    "contention) — profile one window with metric.telemetry.trace_every."
+                ),
+                step_first=steady[0][0],
+                step_last=steady[-1][0],
+                data={"early": early, "late": late, "drop_frac": drop},
+            )
+        )
+    return out
+
+
+def detect_watchdog_incidents(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    incidents = tl.watchdog_incidents()
+    if not incidents:
+        return []
+    escalated = [rec for rec in tl.of("watchdog") if rec.get("action") == "preempt"]
+    traces = [rec.get("trace_dir") for rec in incidents if rec.get("trace_dir")]
+    worst = max(float(rec.get("stalled_s") or 0.0) for rec in incidents)
+    return [
+        Finding(
+            code="watchdog_stall",
+            severity="critical" if escalated else "warning",
+            title=(
+                f"{len(incidents)} watchdog stall incident(s), worst {worst:.0f}s without progress"
+                + (" — escalated to preemption" if escalated else "")
+            ),
+            detail=(
+                f"The heartbeat watchdog fired {len(incidents)} time(s); per-incident "
+                f"profiler traces: {traces if traces else 'none captured'}."
+            ),
+            remediation=(
+                "Open the per-incident trace dir(s) in XProf to see whether the stall "
+                "is device-bound (a wedged collective / remote link) or host-bound (an "
+                "env hang). `resilience.watchdog.action=preempt` converts future stalls "
+                "into checkpoint-and-exit so the supervisor can restart the run."
+            ),
+            step_first=min(int(rec.get("step") or 0) for rec in incidents),
+            step_last=max(int(rec.get("step") or 0) for rec in incidents),
+            data={"incidents": len(incidents), "trace_dirs": traces, "escalated": bool(escalated)},
+        )
+    ]
+
+
+def detect_preemption(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    events = tl.preempt_events()
+    requested = [rec for rec in events if rec.get("action") == "requested"]
+    if not requested:
+        return []
+    checkpointed = [rec for rec in events if rec.get("action") == "checkpointed"]
+    timed_out = [rec for rec in events if rec.get("action") == "flush_timeout"]
+    signal = requested[0].get("signal") or "signal"
+    step = int(requested[0].get("step") or 0)
+    if timed_out:
+        sev, outcome = "critical", "the final checkpoint flush TIMED OUT inside the grace budget"
+    elif checkpointed:
+        sev, outcome = "info", f"drained cleanly with a final checkpoint at step {int(checkpointed[-1].get('step') or 0)}"
+    else:
+        sev, outcome = "warning", "no final checkpoint event was recorded before the stream ended"
+    return [
+        Finding(
+            code="preemption",
+            severity=sev,
+            title=f"run preempted ({signal}) at step {step}: {outcome}",
+            detail=(
+                f"Cooperative preemption requested at step {step} "
+                f"(grace_s={requested[0].get('grace_s')}); {outcome}."
+            ),
+            remediation=(
+                "Resume with `sheeprl_tpu resume run_dir=<this run's version_N dir>` — "
+                "the manifest points at the newest complete checkpoint. If the flush "
+                "timed out, raise `resilience.preemption.grace_s` or shrink the "
+                "checkpoint payload (`buffer.memmap_fast_resume=True`)."
+            ),
+            step_first=step,
+            step_last=max(int(rec.get("step") or 0) for rec in events),
+            data={
+                "signal": signal,
+                "checkpointed": bool(checkpointed),
+                "flush_timeout": bool(timed_out),
+            },
+        )
+    ]
+
+
+def detect_incomplete_stream(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """No shutdown event: the process died without closing telemetry — a
+    crash, OOM-kill or external SIGKILL (a clean preemption still writes
+    shutdown). Torn trailing lines corroborate."""
+    if tl.shutdown is not None or tl.startup is None:
+        return []
+    return [
+        Finding(
+            code="no_shutdown",
+            severity="warning",
+            title="stream ends without a shutdown event (process died mid-run)",
+            detail=(
+                f"Last recorded step {tl.last_step}; {len(tl.parse_errors)} torn/unparseable "
+                "line(s) at the tail of the stream."
+                if tl.parse_errors
+                else f"Last recorded step {tl.last_step}; the final lines are intact, so the "
+                "process was killed between log intervals."
+            ),
+            remediation=(
+                "Check the job scheduler / kernel logs for OOM-kill or SIGKILL. "
+                "`sheeprl_tpu resume run_dir=...` continues from the newest complete "
+                "checkpoint; `resilience.supervisor.attempts>1` auto-restarts future runs."
+            ),
+            step_first=tl.last_step,
+            step_last=tl.last_step,
+            data={"parse_errors": tl.parse_errors[:5]},
+        )
+    ]
+
+
+DETECTORS: List[Callable[[Timeline, Any], List[Finding]]] = [
+    detect_retrace_storm,
+    detect_overlap_starvation,
+    detect_ckpt_spikes,
+    detect_throughput_degradation,
+    detect_watchdog_incidents,
+    detect_preemption,
+    detect_incomplete_stream,
+]
+
+
+def run_detectors(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Run every detector and return findings ranked most-severe first
+    (severity, then first step)."""
+    findings: List[Finding] = []
+    for det in DETECTORS:
+        findings.extend(det(tl, cfg))
+    findings.sort(key=lambda f: (SEVERITY_ORDER.get(f.severity, 9), f.step_first))
+    return findings

@@ -1,0 +1,357 @@
+"""Live Prometheus export: a lock-light metric registry + stdlib HTTP endpoint.
+
+The telemetry JSONL stream is great post-hoc, but a fleet operator wants to
+*scrape* a running job. This module provides the minimal counter / gauge /
+histogram trio rendered in the Prometheus text exposition format (0.0.4) from
+a plain ``ThreadingHTTPServer`` — no client library, no background
+aggregation thread.
+
+Lock discipline ("lock-light"): every metric takes one tiny lock only around
+its own few-field update. Writers are expected to be the learner thread (the
+``Telemetry`` facade mirrors events into the registry from the same thread
+that writes the MetricAggregator) plus the occasional background emitter
+(async checkpoint writer, watchdog) — contention is per-log-interval, never
+per-step, so the locks are noise. Render (`Registry.render`) runs on the
+HTTP thread and only snapshots under the same per-metric locks.
+
+`Registry.observe_event` is the bridge from the JSONL schema: one schema
+event in, the matching counter/gauge/histogram updates out. The same
+registry class backs the policy server's latency / batch-occupancy
+histograms (`serve/batcher.py`), so `GET /metrics` on a PolicyServer and on
+a training run speak the same format.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "PrometheusServer",
+    "start_http_server",
+    "CONTENT_TYPE",
+]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# default bucket ladders (upper bounds, seconds / milliseconds / fractions)
+LATENCY_MS_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0)
+SECONDS_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0)
+FRACTION_BUCKETS = (0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value formatting (ints without trailing .0)."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class Counter:
+    """Monotonically increasing value."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        if v < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (inc {v})")
+        with self._lock:
+            self._value += float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def samples(self) -> List[Tuple[str, float]]:
+        return [(self.name, self.value)]
+
+
+class Gauge:
+    """Set-to-current value."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._value += float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def samples(self) -> List[Tuple[str, float]]:
+        return [(self.name, self.value)]
+
+
+class Histogram:
+    """Fixed-bucket histogram with cumulative rendering and host-side
+    percentile estimation (linear interpolation inside the winning bucket —
+    exact enough for p50/p95/p99 dashboards; the raw buckets are what
+    Prometheus itself aggregates)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", buckets: Sequence[float] = SECONDS_BUCKETS) -> None:
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError(f"histogram {name} needs at least one bucket bound")
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # +1 for +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        idx = len(self.buckets)
+        for i, bound in enumerate(self.buckets):
+            if v <= bound:
+                idx = i
+                break
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def percentile(self, p: float) -> float:
+        """Estimate the p-quantile (0..1) from the bucket counts."""
+        counts, _, total = self.snapshot()
+        if total == 0:
+            return 0.0
+        target = p * total
+        cum = 0
+        lo = 0.0
+        for i, c in enumerate(counts):
+            prev_cum = cum
+            cum += c
+            if cum >= target:
+                hi = self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+                if c == 0:
+                    return hi
+                frac = (target - prev_cum) / c
+                return lo + (hi - lo) * min(1.0, max(0.0, frac))
+            lo = self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+        return self.buckets[-1]
+
+    def samples(self) -> List[Tuple[str, float]]:
+        counts, total_sum, total = self.snapshot()
+        out: List[Tuple[str, float]] = []
+        cum = 0
+        for bound, c in zip(self.buckets, counts):
+            cum += c
+            out.append((f'{self.name}_bucket{{le="{_fmt(bound)}"}}', cum))
+        out.append((f'{self.name}_bucket{{le="+Inf"}}', total))
+        out.append((f"{self.name}_sum", total_sum))
+        out.append((f"{self.name}_count", total))
+        return out
+
+
+class Registry:
+    """Named metric registry rendering the Prometheus text format.
+
+    get-or-create accessors are idempotent (same name → same object), so
+    event-driven code can call them inline without bookkeeping.
+    """
+
+    def __init__(self, prefix: str = "sheeprl") -> None:
+        self.prefix = prefix
+        self._lock = threading.Lock()  # guards the name→metric map only
+        self._metrics: Dict[str, Any] = {}
+
+    def _get(self, cls: Any, name: str, help: str, **kw: Any) -> Any:
+        name = f"{self.prefix}_{name}" if self.prefix and not name.startswith(self.prefix) else name
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name} already registered as {type(m).__name__}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "", buckets: Sequence[float] = SECONDS_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def metrics(self) -> Iterable[Any]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for m in self.metrics():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for sample_name, value in m.samples():
+                lines.append(f"{sample_name} {_fmt(value)}")
+        return "\n".join(lines) + "\n"
+
+    # -- the JSONL bridge ---------------------------------------------------
+    def observe_event(self, rec: Dict[str, Any]) -> None:
+        """Mirror one schema event into the live metrics. Unknown events and
+        missing fields are ignored — the scrape surface must never take down
+        the emitter."""
+        event = rec.get("event")
+        if event == "startup":
+            self.gauge("up", "1 while the run is alive").set(1.0)
+            self.gauge("devices", "visible accelerator devices").set(float(rec.get("devices") or 0))
+        elif event == "log":
+            self.gauge("step", "current policy step").set(float(rec.get("step") or 0))
+            if rec.get("sps") is not None:
+                self.gauge("sps", "policy env-steps per second (log interval)").set(float(rec["sps"]))
+            interval_steps = float(rec.get("interval_steps") or 0)
+            interval_s = float(rec.get("interval_seconds") or 0.0)
+            if interval_steps > 0 and interval_s > 0:
+                step_time = interval_s / interval_steps
+                self.gauge("step_time_seconds", "mean seconds per policy step (log interval)").set(step_time)
+                self.histogram(
+                    "step_time_seconds_hist", "per-interval mean step time", SECONDS_BUCKETS
+                ).observe(step_time)
+            tp = rec.get("throughput") or {}
+            if tp.get("mfu") is not None:
+                self.gauge("mfu", "model FLOPs utilization").set(float(tp["mfu"]))
+            if tp.get("grad_steps_per_s") is not None:
+                self.gauge("grad_steps_per_s", "gradient steps per second").set(float(tp["grad_steps_per_s"]))
+            xla = rec.get("xla") or {}
+            if xla.get("compiles_in_interval"):
+                self.counter("xla_compiles_total", "backend compiles observed in-run").inc(
+                    float(xla["compiles_in_interval"])
+                )
+            # `retraces` is a run-cumulative delta against the run baseline;
+            # export as a gauge so the scrape matches the JSONL semantics
+            if xla.get("retraces") is not None:
+                self.gauge("xla_retraces", "retraces since run start").set(float(xla["retraces"]))
+        elif event == "overlap":
+            self.gauge("overlap_queue_depth", "player→learner queue occupancy").set(
+                float(rec.get("queue_depth") or 0)
+            )
+            self.gauge("overlap_queue_cap", "player→learner queue capacity").set(
+                float(rec.get("queue_cap") or 0)
+            )
+            if rec.get("player_stall_frac") is not None:
+                frac = float(rec["player_stall_frac"])
+                self.gauge("overlap_player_stall_frac", "player stall fraction (interval)").set(frac)
+                self.histogram(
+                    "overlap_player_stall_frac_hist", "player stall fraction", FRACTION_BUCKETS
+                ).observe(frac)
+            if rec.get("staleness_max") is not None:
+                self.gauge("overlap_staleness_max", "interval staleness high-water").set(
+                    float(rec["staleness_max"])
+                )
+        elif event == "ckpt_async":
+            action = rec.get("action")
+            if action in ("enqueued", "written", "failed"):
+                self.counter(f"ckpt_{action}_total", f"checkpoint writes {action}").inc()
+            if rec.get("block_ms") is not None:
+                self.histogram(
+                    "ckpt_block_ms", "train-thread checkpoint blocking time (ms)", LATENCY_MS_BUCKETS
+                ).observe(float(rec["block_ms"]))
+            if rec.get("write_ms") is not None:
+                self.histogram(
+                    "ckpt_write_ms", "background durable-write time (ms)", LATENCY_MS_BUCKETS
+                ).observe(float(rec["write_ms"]))
+        elif event == "retry":
+            self.counter("retries_total", "transient-op retries").inc()
+        elif event == "watchdog":
+            self.counter(f"watchdog_{rec.get('action', 'stall')}_total", "watchdog firings").inc()
+        elif event == "preempt":
+            self.counter(
+                f"preempt_{rec.get('action', 'requested')}_total", "preemption lifecycle events"
+            ).inc()
+        elif event == "shutdown":
+            self.gauge("up", "1 while the run is alive").set(0.0)
+        elif event == "rotate":
+            self.counter("jsonl_rotations_total", "telemetry.jsonl size-cap rotations").inc()
+
+
+class PrometheusServer:
+    """Stdlib ThreadingHTTPServer exposing ``GET /metrics`` for a Registry."""
+
+    def __init__(self, registry: Registry, host: str = "127.0.0.1", port: int = 9100) -> None:
+        self.registry = registry
+        self.host = host
+        self._requested_port = int(port)
+        self._httpd: Any = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd is not None else None
+
+    def start(self) -> "PrometheusServer":
+        if self._httpd is not None:
+            return self
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        registry = self.registry
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt: str, *args: Any) -> None:  # quiet
+                pass
+
+            def do_GET(self) -> None:
+                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                    body = b"not found (try /metrics)\n"
+                    self.send_response(404)
+                else:
+                    body = registry.render().encode()
+                    self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((self.host, self._requested_port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True, name="prometheus-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+
+
+def start_http_server(registry: Registry, port: int, host: str = "127.0.0.1") -> PrometheusServer:
+    """Convenience: build + start a `/metrics` endpoint for `registry`."""
+    return PrometheusServer(registry, host=host, port=port).start()

@@ -107,7 +107,7 @@ class SpscRing:
 class Packet:
     """One env-interaction slice crossing the player→learner queue."""
 
-    __slots__ = ("payload", "env_steps", "version", "staleness", "produced_t")
+    __slots__ = ("payload", "env_steps", "version", "staleness", "produced_t", "produced_step")
 
     def __init__(self, payload: Any, env_steps: int):
         self.payload = payload
@@ -115,6 +115,7 @@ class Packet:
         self.version = 0  # published-params version the player acted with
         self.staleness = 0  # bursts in flight at production time (≤ bound)
         self.produced_t = 0.0
+        self.produced_step = 0  # player env-step counter AFTER this slice
 
     # -- replay-buffer op payloads ----------------------------------------
     def apply(self, rb: Any, aggregator: Any = None) -> None:
@@ -342,6 +343,9 @@ class OverlapEngine:
                 pkt.version = self._pub_seq
                 pkt.staleness = self._burst_seq - self._pub_seq
                 pkt.produced_t = time.perf_counter()
+                # step-id stamp: the player's env-step counter once this
+                # slice lands — diag correlates player/learner spans with it
+                pkt.produced_step = self.produced_steps + pkt.env_steps
 
                 t0 = time.perf_counter()
                 # sole producer + pre-checked free slot: effectively
@@ -446,6 +450,7 @@ class OverlapEngine:
         rec = {
             "event": "overlap",
             "step": int(self.acked_steps),
+            "player_step": int(self.produced_steps),
             "queue_depth": int(len(self._ring)),
             "queue_cap": int(self.queue_depth),
             "packets": int(self.packets_produced),

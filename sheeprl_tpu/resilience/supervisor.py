@@ -137,6 +137,7 @@ class HeartbeatWatchdog:
         self._last_step: Optional[int] = None
         self._last_t = time.monotonic()
         self._fired = False
+        self._incidents = 0  # monotonic per-run stall counter (trace dir names)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -166,10 +167,11 @@ class HeartbeatWatchdog:
             if stalled_s < self.stall_s or self._fired:
                 continue
             self._fired = True
+            self._incidents += 1
             step = self._last_step or 0
             print(
                 f"[resilience] watchdog: no step advance for {stalled_s:.0f}s "
-                f"(last step {step}); action={self.action}",
+                f"(last step {step}, incident {self._incidents}); action={self.action}",
                 file=sys.stderr,
                 flush=True,
             )
@@ -179,6 +181,7 @@ class HeartbeatWatchdog:
                 "action": "stall",
                 "step": step,
                 "stalled_s": round(stalled_s, 1),
+                "incident": self._incidents,
             }
             if trace_dir:
                 rec["trace_dir"] = trace_dir
@@ -198,13 +201,21 @@ class HeartbeatWatchdog:
     def _dump_trace(self) -> Optional[str]:
         """Capture a short profiler window so the stall is attributable
         (device-bound vs host-bound) post-mortem. Best-effort: an active
-        outer trace or an unsupported backend must not break the watchdog."""
+        outer trace or an unsupported backend must not break the watchdog.
+
+        Each dump lands in a UNIQUE per-incident directory — the monotonic
+        incident counter in the name guarantees repeated stalls in one run
+        (or two stalls inside the same wall-clock second) never overwrite an
+        earlier trace. The path rides on the `watchdog` JSONL event so the
+        doctor can point straight at it."""
         if not self.trace_dir:
             return None
         try:
             import jax.profiler as prof
 
-            out = os.path.join(self.trace_dir, f"stall_{int(time.time())}")
+            out = os.path.join(
+                self.trace_dir, f"incident_{self._incidents:03d}_{int(time.time())}"
+            )
             prof.start_trace(out)
             time.sleep(max(0.1, self.trace_s))
             prof.stop_trace()

@@ -53,9 +53,17 @@ class _Request:
 
 
 class ServeStats:
-    """Thread-safe serving counters + latency reservoir."""
+    """Thread-safe serving counters backed by a Prometheus registry.
 
-    def __init__(self, reservoir: int = 4096) -> None:
+    Every update lands in a :class:`~sheeprl_tpu.diag.prometheus.Registry`
+    (latency / batch-occupancy histograms, request counters) — the registry
+    `PolicyServer`'s ``GET /metrics`` renders, and the SAME histogram the
+    p50/p95/p99 in the ``/stats`` snapshot are estimated from (bucket
+    interpolation), so the two surfaces always agree."""
+
+    def __init__(self, registry: Any = None) -> None:
+        from ..diag.prometheus import FRACTION_BUCKETS, LATENCY_MS_BUCKETS, Registry
+
         self._lock = threading.Lock()
         self.requests = 0
         self.completed = 0
@@ -65,15 +73,30 @@ class ServeStats:
         self.batched_items = 0
         self._occupancy_sum = 0.0
         self._batch_seconds_sum = 0.0
-        self._latencies: Deque[float] = deque(maxlen=reservoir)
+        self.registry = registry if registry is not None else Registry(prefix="sheeprl_serve")
+        self._m_requests = self.registry.counter("requests_total", "act requests submitted")
+        self._m_rejected = self.registry.counter("rejected_total", "requests rejected (backpressure)")
+        self._m_completed = self.registry.counter("completed_total", "requests served")
+        self._m_errors = self.registry.counter("errors_total", "requests failed")
+        self._m_latency = self.registry.histogram(
+            "latency_ms", "submit→result latency (ms)", LATENCY_MS_BUCKETS
+        )
+        self._m_occupancy = self.registry.histogram(
+            "batch_occupancy", "batch fill fraction of its compiled bucket", FRACTION_BUCKETS
+        )
+        self._m_batch_size = self.registry.histogram(
+            "batch_size", "coalesced batch width", (1, 2, 4, 8, 16, 32, 64, 128)
+        )
 
     def record_submit(self) -> None:
         with self._lock:
             self.requests += 1
+        self._m_requests.inc()
 
     def record_reject(self) -> None:
         with self._lock:
             self.rejected += 1
+        self._m_rejected.inc()
 
     def record_batch(self, n: int, bucket: int, seconds: float) -> None:
         with self._lock:
@@ -81,6 +104,8 @@ class ServeStats:
             self.batched_items += n
             self._occupancy_sum += n / max(1, bucket)
             self._batch_seconds_sum += seconds
+        self._m_occupancy.observe(n / max(1, bucket))
+        self._m_batch_size.observe(n)
 
     def record_done(self, latency_s: float, error: bool = False) -> None:
         with self._lock:
@@ -88,13 +113,8 @@ class ServeStats:
                 self.errors += 1
             else:
                 self.completed += 1
-            self._latencies.append(latency_s * 1000.0)
-
-    def _percentile(self, sorted_ms: List[float], p: float) -> float:
-        if not sorted_ms:
-            return 0.0
-        idx = min(len(sorted_ms) - 1, int(round(p * (len(sorted_ms) - 1))))
-        return sorted_ms[idx]
+        (self._m_errors if error else self._m_completed).inc()
+        self._m_latency.observe(latency_s * 1000.0)
 
     def avg_batch_seconds(self) -> float:
         with self._lock:
@@ -102,8 +122,7 @@ class ServeStats:
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
-            lat = sorted(self._latencies)
-            return {
+            out = {
                 "requests": self.requests,
                 "completed": self.completed,
                 "rejected": self.rejected,
@@ -115,9 +134,10 @@ class ServeStats:
                 "avg_batch_size": round(self.batched_items / self.batches, 4)
                 if self.batches
                 else 0.0,
-                "p50_ms": round(self._percentile(lat, 0.50), 3),
-                "p99_ms": round(self._percentile(lat, 0.99), 3),
             }
+        for name, p in (("p50_ms", 0.50), ("p95_ms", 0.95), ("p99_ms", 0.99)):
+            out[name] = round(self._m_latency.percentile(p), 3)
+        return out
 
 
 class MicroBatcher:

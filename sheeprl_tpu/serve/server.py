@@ -16,7 +16,10 @@ Endpoints:
     POST /v1/act      {"obs": {...}, "deterministic": bool, "session_id": str}
                       -> {"actions": [[...]], "params_version": int}
     GET  /healthz     liveness + params version
-    GET  /stats       full serve telemetry snapshot (the `serve` JSONL record)
+    GET  /stats       full serve telemetry snapshot (the `serve` JSONL record,
+                      incl. p50/p95/p99 latency)
+    GET  /metrics     Prometheus text format (latency + batch-occupancy
+                      histograms backed by diag/prometheus.py's registry)
     503 + Retry-After when the queue is saturated (Backpressure)
 
 `serve_from_checkpoint` is the CLI entrypoint's workhorse: checkpoint →
@@ -71,6 +74,22 @@ class PolicyServer:
 
     def stats(self) -> Dict[str, Any]:
         return self.batcher.serve_record()
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the serving registry (latency /
+        batch-occupancy histograms + request counters from ServeStats),
+        with the point-in-time gauges refreshed at render."""
+        registry = self.batcher.stats.registry
+        registry.gauge("queue_depth", "pending act requests").set(float(self.batcher.queue_depth))
+        registry.gauge("params_version", "hot-reload params version").set(
+            float(self.policy.params_version)
+        )
+        registry.gauge("reloads", "successful hot reloads").set(float(self.policy.reload_count))
+        registry.gauge("retraces", "retraces since warmup (0 is the invariant)").set(
+            float(self.policy.retraces_since_warmup())
+        )
+        registry.gauge("sessions", "live recurrent sessions").set(float(len(self.policy.sessions)))
+        return registry.render()
 
     @property
     def port(self) -> Optional[int]:
@@ -145,6 +164,15 @@ def _make_handler(server: "PolicyServer"):
                 )
             elif self.path == "/stats":
                 self._reply(200, server.stats())
+            elif self.path == "/metrics":
+                from ..diag.prometheus import CONTENT_TYPE
+
+                body = server.metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 

@@ -15,6 +15,7 @@ from .throughput import (
     flops_of_lowered,
     measured_cpu_peak_flops,
     mfu,
+    peak_flops_basis_for,
     peak_flops_for,
     peak_flops_record,
 )
@@ -45,6 +46,7 @@ __all__ = [
     "flops_of_lowered",
     "measured_cpu_peak_flops",
     "mfu",
+    "peak_flops_basis_for",
     "peak_flops_for",
     "peak_flops_record",
     "RETRACE_DETECTOR",

@@ -21,12 +21,13 @@ Loops use five calls:
 from __future__ import annotations
 
 import os
+import sys
 import time
 from typing import Any, Dict, Optional
 
 from ..utils.metric import MetricAggregator
 from . import xla as _xla
-from .sinks import ConsoleHeartbeat, JsonlSink
+from .sinks import DEFAULT_JSONL_MAX_BYTES, ConsoleHeartbeat, JsonlSink
 from .spans import GLOBAL_TRACKER, Span, SpanTracker
 from .schema import SCHEMA_VERSION
 from .throughput import ThroughputTracker, peak_flops_record
@@ -87,10 +88,39 @@ class Telemetry:
             run_name=str(sel("run_name", "") or ""),
         )
 
-        # sinks — JSONL only on rank 0 (one stream per run, not per host)
+        # sinks — JSONL only on rank 0 (one stream per run, not per host);
+        # size-bounded: past jsonl_max_bytes the file rolls to .1/.2/… so a
+        # week-long run cannot fill the disk (diag readers follow segments)
         self.jsonl: Optional[JsonlSink] = None
         if self.enabled and self.rank == 0 and log_dir and bool(sel("metric.telemetry.jsonl", True)):
-            self.jsonl = JsonlSink(os.path.join(log_dir, "telemetry.jsonl"))
+            max_bytes = sel("metric.telemetry.jsonl_max_bytes")
+            self.jsonl = JsonlSink(
+                os.path.join(log_dir, "telemetry.jsonl"),
+                max_bytes=DEFAULT_JSONL_MAX_BYTES if max_bytes is None else int(max_bytes),
+                # rotation happens inside the sink (not through _emit), so
+                # mirror the marker into the scrape registry via callback
+                on_rotate=lambda marker: self.prom.observe_event(marker)
+                if self.prom is not None
+                else None,
+            )
+        # live Prometheus export (diag/prometheus.py): a /metrics endpoint
+        # fed by mirroring the same events the JSONL sink gets. Off by
+        # default (port 0); rank 0 only — one scrape surface per run.
+        self.prom = None
+        self._prom_server = None
+        prom_port = int(sel("metric.telemetry.prometheus_port", 0) or 0)
+        if self.enabled and self.rank == 0 and prom_port > 0:
+            try:
+                from ..diag.prometheus import Registry, start_http_server
+
+                self.prom = Registry()
+                self._prom_server = start_http_server(
+                    self.prom, prom_port, host=str(sel("metric.telemetry.prometheus_host", "127.0.0.1"))
+                )
+            except Exception as err:
+                print(f"[telemetry] prometheus export disabled: {err}", file=sys.stderr)
+                self.prom = None
+                self._prom_server = None
         # the startup heartbeat is intentionally independent of log_level:
         # a run degraded to cpu-fallback must say so even with metrics off
         hb_on = bool(sel("metric.telemetry.heartbeat", True))
@@ -141,6 +171,16 @@ class Telemetry:
     def _emit(self, rec: Dict[str, Any]) -> None:
         if self.jsonl is not None:
             self.jsonl.write(rec)
+        if self.prom is not None:
+            # mirror into the live scrape surface. Writes follow the same
+            # rule as MetricAggregator — the learner thread owns the hot
+            # paths (log/overlap); background emitters (ckpt writer,
+            # watchdog) only touch their own counters/histograms, each
+            # guarded by its per-metric lock.
+            try:
+                self.prom.observe_event(rec)
+            except Exception:
+                pass
 
     def emit(self, rec: Dict[str, Any]) -> None:
         """Write one schema-validated event to the JSONL stream — the public
@@ -338,6 +378,10 @@ class Telemetry:
         if self._transfers is not None:
             self._transfers.uninstall()
             self._transfers = None
+        if self._prom_server is not None:
+            self._prom_server.stop()
+            self._prom_server = None
+            self.prom = None
         if self.jsonl is not None:
             self.jsonl.close()
             self.jsonl = None

@@ -91,6 +91,7 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "batch_occupancy": (False, _NUM),
         "avg_batch_size": (False, _NUM),
         "p50_ms": (False, _NUM),
+        "p95_ms": (False, _NUM),
         "p99_ms": (False, _NUM),
         "retraces": (False, _NUM),
         "reloads": (False, _NUM),
@@ -132,17 +133,26 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "error": (False, _STR),
         "sleep_s": (False, _NUM),
     },
-    # stalled-progress watchdog firings (resilience/supervisor.py)
+    # stalled-progress watchdog firings (resilience/supervisor.py);
+    # `incident` is the run-monotonic incident counter, `trace_dir` the
+    # per-incident profiler dump directory (unique — repeated stalls in one
+    # run never overwrite an earlier trace)
     "watchdog": {
         "action": (True, _STR),  # stall | preempt
         "step": (False, _NUM),
         "stalled_s": (False, _NUM),
         "trace_dir": (False, _STR),
+        "incident": (False, _NUM),
     },
     # overlapped player/learner engine interval stats (engine/overlap.py):
-    # stall split, queue occupancy and the bounded-staleness high-water mark
+    # stall split, queue occupancy and the bounded-staleness high-water mark.
+    # `step` is the LEARNER's acknowledged env-step counter; `player_step`
+    # the PLAYER's produced counter at emit time — the pair lets diag
+    # correlate player and learner spans on one step axis (their difference
+    # is the in-queue lead, bounded by queue_cap packets)
     "overlap": {
         "step": (True, _NUM),
+        "player_step": (False, _NUM),
         "queue_depth": (False, _NUM),
         "queue_cap": (False, _NUM),
         "packets": (False, _NUM),
@@ -154,6 +164,14 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "player_stall_frac": (False, _NUM),
         "staleness_max": (False, _NUM),
         "interval_s": (False, _NUM),
+    },
+    # size-bounded JSONL rotation marker (telemetry/sinks.py): first line of
+    # each new segment after the previous one rolled to `<path>.<segment>`
+    # (monotonic index — lower is older; diag readers rely on the order)
+    "rotate": {
+        "segment": (True, _NUM),
+        "path": (False, _STR),
+        "bytes": (False, _NUM),
     },
     # a run restored from a checkpoint (resilience/guard.py)
     "resume": {

@@ -16,10 +16,10 @@ from typing import Any, Dict, IO, Optional
 from .schema import validate_event
 
 
-def write_event(rec: Dict[str, Any], stream: Optional[IO[str]] = None, strict: bool = False) -> Dict[str, Any]:
-    """Validate and write one event as a single JSONL line.
+def _render_event(rec: Dict[str, Any], strict: bool = False) -> str:
+    """Validate and serialize one event to its JSONL line.
 
-    Invalid records are written anyway with a stderr note (telemetry must
+    Invalid records are rendered anyway with a stderr note (telemetry must
     never take down a run) unless ``strict=True``.
     """
     errors = validate_event(rec)
@@ -27,8 +27,13 @@ def write_event(rec: Dict[str, Any], stream: Optional[IO[str]] = None, strict: b
         if strict:
             raise ValueError(f"invalid telemetry event: {errors}")
         print(f"[telemetry] schema warning: {errors}", file=sys.stderr)
+    return json.dumps(rec) + "\n"
+
+
+def write_event(rec: Dict[str, Any], stream: Optional[IO[str]] = None, strict: bool = False) -> Dict[str, Any]:
+    """Validate and write one event as a single JSONL line."""
     out = stream if stream is not None else sys.stdout
-    out.write(json.dumps(rec) + "\n")
+    out.write(_render_event(rec, strict))
     try:
         out.flush()
     except Exception:
@@ -36,20 +41,102 @@ def write_event(rec: Dict[str, Any], stream: Optional[IO[str]] = None, strict: b
     return rec
 
 
-class JsonlSink:
-    """Append-only newline-delimited JSON event file (thread-safe)."""
+DEFAULT_JSONL_MAX_BYTES = 256 * 1024 * 1024  # week-long runs must not fill the disk
 
-    def __init__(self, path: str) -> None:
+
+class JsonlSink:
+    """Append-only newline-delimited JSON event file (thread-safe) with
+    size-bounded rotation.
+
+    Past ``max_bytes`` the live file rolls to ``<path>.<n>`` where ``n`` is a
+    MONOTONIC segment index (``telemetry.jsonl.1`` is the oldest segment —
+    numeric ascending order is chronological order, which is what
+    `diag.timeline.rotated_segments` reads back). Each fresh segment opens
+    with a ``rotate`` marker event naming the segment it just closed.
+    ``max_bytes=0`` / ``None`` disables rotation (pre-existing behaviour).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        max_bytes: Optional[int] = DEFAULT_JSONL_MAX_BYTES,
+        on_rotate: Optional[Any] = None,
+    ) -> None:
         self.path = path
+        self.max_bytes = int(max_bytes or 0)
+        self.on_rotate = on_rotate  # callback(marker_rec) after each roll
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._lock = threading.Lock()
         self._fh: Optional[IO[str]] = open(path, "a")
+        try:
+            self._size = os.path.getsize(path)
+        except OSError:
+            self._size = 0
+        self._segment = self._next_segment_index()
+
+    def _next_segment_index(self) -> int:
+        """1 + the highest existing rotated index (resumed runs keep rolling
+        where the previous process stopped)."""
+        best = 0
+        prefix = os.path.basename(self.path) + "."
+        try:
+            for name in os.listdir(os.path.dirname(self.path) or "."):
+                if name.startswith(prefix) and name[len(prefix) :].isdigit():
+                    best = max(best, int(name[len(prefix) :]))
+        except OSError:
+            pass
+        return best + 1
+
+    def _rotate_locked(self) -> None:
+        """Roll the live file to `<path>.<segment>`. Rotation must never
+        take down telemetry: a failed rename keeps appending to the live
+        file (over the cap), and a failed reopen disables the sink (writes
+        become no-ops) instead of leaving a closed handle to crash on."""
+        if self._fh is None:
+            return
+        try:
+            self._fh.close()
+        finally:
+            self._fh = None
+        rolled: Optional[str] = f"{self.path}.{self._segment}"
+        try:
+            os.replace(self.path, rolled)
+        except OSError:
+            rolled = None
+        try:
+            self._fh = open(self.path, "a")
+        except OSError:
+            return
+        if rolled is None:
+            return  # same file, same size — retry the roll at the next cap
+        self._size = 0
+        marker = {"event": "rotate", "segment": self._segment, "path": rolled}
+        self._segment += 1
+        self._size += self._write_line_locked(marker)
+        if self.on_rotate is not None:
+            try:
+                self.on_rotate(marker)
+            except Exception:
+                pass
+
+    def _write_line_locked(self, rec: Dict[str, Any]) -> int:
+        """Serialize ONCE, write + flush, return the byte count (the same
+        string feeds the rotation size tracker)."""
+        line = _render_event(rec)
+        self._fh.write(line)
+        try:
+            self._fh.flush()
+        except Exception:
+            pass
+        return len(line)
 
     def write(self, rec: Dict[str, Any]) -> None:
         with self._lock:
             if self._fh is None:
                 return
-            write_event(rec, self._fh)
+            self._size += self._write_line_locked(rec)
+            if self.max_bytes and self._size >= self.max_bytes:
+                self._rotate_locked()
 
     def close(self) -> None:
         with self._lock:

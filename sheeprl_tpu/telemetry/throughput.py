@@ -11,9 +11,12 @@ import time
 from typing import Any, Dict, Optional
 
 # peak dense-matmul FLOP/s per chip by device kind (bf16 for TPUs — the MXU's
-# native precision and the standard MFU convention). Substring-matched.
+# native precision and the standard MFU convention). Substring-matched, most
+# specific (longest) key first, so "TPU v5e" never lands on a shorter prefix.
 PEAK_FLOPS: Dict[str, float] = {
-    "v6": 918e12,  # Trillium
+    "trillium": 918e12,
+    "v6e": 918e12,  # Trillium
+    "v6": 918e12,
     "v5p": 459e12,
     "v5e": 197e12,
     "v5 lite": 197e12,
@@ -25,11 +28,13 @@ PEAK_FLOPS: Dict[str, float] = {
 
 
 def peak_flops_for(device: Any) -> Optional[float]:
-    """Vendor bf16 peak FLOP/s for a device, by `device_kind` substring."""
+    """Vendor bf16 peak FLOP/s for a device, by `device_kind` substring
+    (longest match wins — "v5e" must not resolve through a bare "v5"-style
+    prefix if one is ever added)."""
     kind = (getattr(device, "device_kind", "") or "").lower()
-    for sub, peak in PEAK_FLOPS.items():
+    for sub in sorted(PEAK_FLOPS, key=len, reverse=True):
         if sub in kind:
-            return peak
+            return PEAK_FLOPS[sub]
     return None
 
 
@@ -81,22 +86,36 @@ def mfu(flops_per_step: float, steps_per_sec: float, peak_flops: float, n_device
     return flops_per_step * steps_per_sec / (peak_flops * max(1, n_devices))
 
 
+_VENDOR_BASIS = "vendor bf16 peak by device_kind"
+_CPU_MEASURED_BASIS = "measured 1024^3 f32 matmul on cpu (not vendor peak)"
+
+
+def peak_flops_basis_for(device: Any) -> str:
+    """The basis LABEL alone — which class of denominator MFU figures on
+    this device would use — without running the host matmul measurement.
+    Cheap enough to stamp on every bench record, including ones that carry
+    no MFU at all."""
+    if peak_flops_for(device) is not None:
+        return _VENDOR_BASIS
+    if getattr(device, "platform", "") == "cpu":
+        return _CPU_MEASURED_BASIS
+    return f"unknown device_kind {getattr(device, 'device_kind', '')!r}; mfu omitted"
+
+
 def peak_flops_record(device: Any, allow_cpu_measure: bool = True) -> Dict[str, Any]:
     """{peak_flops, peak_flops_basis} for a device — vendor table first,
     measured host matmul on CPU, neither on unknown accelerators."""
     peak = peak_flops_for(device)
     if peak is not None:
-        return {"peak_flops": peak, "peak_flops_basis": "vendor bf16 peak by device_kind"}
-    if getattr(device, "platform", "") == "cpu" and allow_cpu_measure:
-        return {
-            "peak_flops": measured_cpu_peak_flops(),
-            "peak_flops_basis": "measured 1024^3 f32 matmul on cpu (not vendor peak)",
-        }
+        return {"peak_flops": peak, "peak_flops_basis": _VENDOR_BASIS}
+    if getattr(device, "platform", "") == "cpu":
+        if allow_cpu_measure:
+            return {"peak_flops": measured_cpu_peak_flops(), "peak_flops_basis": _CPU_MEASURED_BASIS}
+        # no peak AND no measurement: the basis must not claim one ran
+        return {"peak_flops": None, "peak_flops_basis": "cpu matmul measurement disabled; mfu omitted"}
     return {
         "peak_flops": None,
-        "peak_flops_basis": (
-            f"unknown device_kind {getattr(device, 'device_kind', '')!r}; mfu omitted"
-        ),
+        "peak_flops_basis": f"unknown device_kind {getattr(device, 'device_kind', '')!r}; mfu omitted",
     }
 
 

@@ -1,0 +1,707 @@
+"""Run-diagnostics layer (sheeprl_tpu/diag/): flight-recorder analysis +
+doctor CLI over a synthetic 512-step multi-incident run, JSONL rotation,
+schema round-trips for the new event fields, the Prometheus registry and a
+LIVE /metrics scrape during a real PPO smoke run, and the bench regression
+gate (synthetic 20% regression flagged, real BENCH_r01..r05 trajectory
+passes)."""
+import importlib.util
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from sheeprl_tpu.diag import (
+    Registry,
+    Timeline,
+    diagnose,
+    iter_events,
+    render_text,
+    rotated_segments,
+    run_detectors,
+    start_http_server,
+)
+from sheeprl_tpu.telemetry.schema import validate_event, validate_jsonl
+from sheeprl_tpu.telemetry.sinks import JsonlSink
+
+REPO = Path(__file__).resolve().parent.parent
+
+spec = importlib.util.spec_from_file_location("bench_compare", REPO / "scripts" / "bench_compare.py")
+bench_compare = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench_compare)
+
+
+# -- the synthetic 512-step multi-incident run ------------------------------
+
+
+def _write_jsonl(path: Path, events) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as fh:
+        for rec in events:
+            fh.write(json.dumps(rec) + "\n")
+
+
+def make_incident_run(run_dir: Path) -> Path:
+    """A recorded 512-step run with an injected retrace storm, an overlap
+    queue stall and a SIGTERM preemption (the acceptance fixture)."""
+    events = [
+        {
+            "event": "startup",
+            "platform": "cpu",
+            "device_kind": "cpu",
+            "devices": 1,
+            "rank": 0,
+            "algo": "sac",
+            "schema_version": 1,
+        }
+    ]
+    retraces = 0
+    for step in range(32, 513, 32):
+        xla = {"compile_count": 4, "compiles_in_interval": 0, "retraces": retraces}
+        if 128 <= step <= 256:  # the storm window: +2 retraces per interval
+            retraces += 2
+            xla["retraces"] = retraces
+            xla["retrace_attribution"] = [
+                f"train_step arg 1: shape (32, {step}) -> (32, {step + 32})"
+            ]
+        events.append(
+            {
+                "event": "log",
+                "step": step,
+                "sps": 120.0 if step <= 64 else 100.0,
+                "interval_steps": 32,
+                "interval_seconds": 0.3,
+                "metrics": {},
+                "spans": {"Time/train_time": 0.2, "Time/env_interaction_time": 0.1},
+                "throughput": {"sps": 100.0, "grad_steps_per_s": 50.0},
+                "xla": xla,
+                "memory": {},
+            }
+        )
+        if step >= 320:  # the queue stall window: the player starves
+            events.append(
+                {
+                    "event": "overlap",
+                    "step": step,
+                    "player_step": step + 32,
+                    "queue_depth": 4,
+                    "queue_cap": 4,
+                    "player_busy_s": 0.05,
+                    "player_stall_s": 0.45,
+                    "player_stall_frac": 0.9,
+                    "staleness_max": 1,
+                    "interval_s": 0.5,
+                }
+            )
+    events.append(
+        {"event": "preempt", "step": 480, "action": "requested", "signal": "SIGTERM", "grace_s": 30.0}
+    )
+    events.append({"event": "preempt", "step": 480, "action": "checkpointed"})
+    events.append({"event": "shutdown", "step": 480, "xla": {"retraces": retraces}})
+    stream = run_dir / "telemetry.jsonl"
+    _write_jsonl(stream, events)
+    return run_dir
+
+
+def test_doctor_reports_all_three_incidents(tmp_path):
+    run_dir = make_incident_run(tmp_path / "incident_run")
+    report = diagnose(run_dir)
+    codes = [f["code"] for f in report["findings"]]
+    assert "retrace_storm" in codes
+    assert "overlap_starvation" in codes
+    assert "preemption" in codes
+    # ranked most-severe first: the storm (critical) leads
+    assert report["findings"][0]["code"] == "retrace_storm"
+    assert report["last_step"] == 512
+    assert report["clean_shutdown"] is True
+    # every finding carries a concrete remediation hint
+    assert all(f["remediation"] for f in report["findings"])
+    storm = next(f for f in report["findings"] if f["code"] == "retrace_storm")
+    assert storm["data"]["retraces"] == 10
+    assert any("shape" in a for a in storm["data"]["attribution"])
+
+
+def test_doctor_text_and_json_cli(tmp_path, capsys):
+    run_dir = make_incident_run(tmp_path / "incident_run")
+    from sheeprl_tpu.cli import doctor
+
+    doctor([f"run_dir={run_dir}"])
+    text = capsys.readouterr().out
+    assert "retrace storm" in text
+    assert "overlap queue starvation" in text
+    assert "preempted" in text
+    assert "fix:" in text  # remediation hints rendered
+    assert "NEEDS ATTENTION" in text  # a critical finding flips the verdict
+
+    doctor([f"run_dir={run_dir}", "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert {f["code"] for f in report["findings"]} >= {
+        "retrace_storm",
+        "overlap_starvation",
+        "preemption",
+    }
+    assert report["healthy"] is False
+
+    with pytest.raises(SystemExit):
+        doctor([f"run_dir={run_dir}", "strict=true"])
+
+
+def test_doctor_healthy_run_has_no_findings(tmp_path):
+    events = [
+        {"event": "startup", "platform": "cpu", "device_kind": "cpu", "devices": 1, "rank": 0},
+        {
+            "event": "log",
+            "step": 64,
+            "sps": 100.0,
+            "interval_steps": 64,
+            "interval_seconds": 0.5,
+            "xla": {"retraces": 0},
+        },
+        {"event": "shutdown", "step": 64},
+    ]
+    _write_jsonl(tmp_path / "run" / "telemetry.jsonl", events)
+    report = diagnose(tmp_path / "run")
+    assert report["findings"] == []
+    assert report["healthy"] is True
+    assert "HEALTHY" in render_text(report)
+
+
+def test_detector_no_shutdown_and_degradation():
+    tl = Timeline(
+        [{"event": "startup", "platform": "cpu", "device_kind": "cpu", "devices": 1, "rank": 0}]
+        + [
+            {
+                "event": "log",
+                "step": s,
+                "sps": 100.0 if s <= 256 else 60.0,  # 40% in-run decay
+                "interval_steps": 32,
+                "interval_seconds": 0.3,
+            }
+            for s in range(32, 513, 32)
+        ]
+    )
+    codes = {f.code for f in run_detectors(tl)}
+    assert "sps_degradation" in codes
+    assert "no_shutdown" in codes
+
+
+# -- JSONL rotation ----------------------------------------------------------
+
+
+def _startup_rec(i):
+    return {
+        "event": "startup",
+        "platform": "cpu",
+        "device_kind": f"cpu-{i:04d}",
+        "devices": 1,
+        "rank": 0,
+    }
+
+
+def test_jsonl_sink_rotates_and_reader_follows_segments(tmp_path):
+    path = tmp_path / "telemetry.jsonl"
+    sink = JsonlSink(str(path), max_bytes=300)
+    n = 12
+    for i in range(n):
+        sink.write(_startup_rec(i))
+    sink.close()
+
+    segments = rotated_segments(path)
+    assert len(segments) > 2, "cap of 300 bytes must have rotated several times"
+    assert segments[0].name == "telemetry.jsonl.1"  # oldest first
+    assert segments[-1] == path  # live file last
+    for seg in segments:
+        assert validate_jsonl(seg) == [], f"rotated segment {seg} fails schema validation"
+
+    events = list(iter_events(path))
+    markers = [e for e in events if e["event"] == "rotate"]
+    assert markers and markers[0]["segment"] == 1
+    assert all(validate_event(m) == [] for m in markers)
+    # every written record survives rotation, in original order
+    kinds = [e["device_kind"] for e in events if e["event"] == "startup"]
+    assert kinds == [f"cpu-{i:04d}" for i in range(n)]
+
+
+def test_jsonl_sink_resumed_process_continues_segment_numbering(tmp_path):
+    path = tmp_path / "telemetry.jsonl"
+    sink = JsonlSink(str(path), max_bytes=150)
+    for i in range(4):
+        sink.write(_startup_rec(i))
+    sink.close()
+    first_segments = len(rotated_segments(path))
+    sink2 = JsonlSink(str(path), max_bytes=150)  # a resume reopens the stream
+    for i in range(4, 8):
+        sink2.write(_startup_rec(i))
+    sink2.close()
+    assert len(rotated_segments(path)) > first_segments
+    kinds = [e["device_kind"] for e in iter_events(path) if e["event"] == "startup"]
+    assert kinds == [f"cpu-{i:04d}" for i in range(8)]
+
+
+def test_jsonl_sink_rotation_mirrors_marker_and_survives_reopen_failure(tmp_path, monkeypatch):
+    markers = []
+    path = tmp_path / "telemetry.jsonl"
+    sink = JsonlSink(str(path), max_bytes=150, on_rotate=markers.append)
+    for i in range(4):
+        sink.write(_startup_rec(i))
+    assert markers and markers[0]["event"] == "rotate"
+    # the registry branch the facade's on_rotate feeds
+    reg = Registry()
+    reg.observe_event(markers[0])
+    assert "sheeprl_jsonl_rotations_total 1" in reg.render()
+
+    # a failed reopen during rotation must disable the sink, not crash writes
+    import builtins
+
+    real_open = builtins.open
+    monkeypatch.setattr(
+        builtins,
+        "open",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("fd exhausted"))
+        if a and str(a[0]) == str(path)
+        else real_open(*a, **k),
+    )
+    for i in range(4, 10):
+        sink.write(_startup_rec(i))  # crosses the cap → reopen fails → no-op
+    monkeypatch.undo()
+    sink.close()
+
+
+def test_doctor_bench_gate_survives_corrupt_artifact(tmp_path):
+    run_dir = make_incident_run(tmp_path / "run")
+    (tmp_path / "BENCH_r01.json").write_text('{"truncated": ')  # half-written
+    report = diagnose(run_dir, bench_dir=tmp_path)
+    assert report["findings"], "the run diagnosis must survive a corrupt bench artifact"
+    assert report["bench"]["ok"] is False
+    assert any("unreadable" in f for f in report["bench"]["failures"])
+
+
+def test_peak_flops_basis_label_without_measurement():
+    from sheeprl_tpu.telemetry.throughput import peak_flops_basis_for
+
+    class Dev:
+        def __init__(self, kind, platform):
+            self.device_kind = kind
+            self.platform = platform
+
+    assert peak_flops_basis_for(Dev("TPU v5e", "tpu")) == "vendor bf16 peak by device_kind"
+    assert peak_flops_basis_for(Dev("TPU v6e", "tpu")) == "vendor bf16 peak by device_kind"
+    assert "measured" in peak_flops_basis_for(Dev("cpu", "cpu"))
+    assert "unknown" in peak_flops_basis_for(Dev("quantum", "qpu"))
+
+
+def test_jsonl_sink_rotation_disabled_by_zero(tmp_path):
+    path = tmp_path / "telemetry.jsonl"
+    sink = JsonlSink(str(path), max_bytes=0)
+    for i in range(20):
+        sink.write(_startup_rec(i))
+    sink.close()
+    assert rotated_segments(path) == [path]
+
+
+# -- schema round-trips for the new fields ----------------------------------
+
+
+def test_schema_new_fields_roundtrip():
+    assert (
+        validate_event(
+            {
+                "event": "overlap",
+                "step": 128,
+                "player_step": 256,
+                "queue_depth": 2,
+                "player_stall_frac": 0.1,
+            }
+        )
+        == []
+    )
+    assert (
+        validate_event(
+            {
+                "event": "watchdog",
+                "action": "stall",
+                "step": 64,
+                "stalled_s": 12.0,
+                "incident": 2,
+                "trace_dir": "/tmp/xprof_watchdog/incident_002_123",
+            }
+        )
+        == []
+    )
+    assert validate_event({"event": "rotate", "segment": 1, "path": "t.jsonl.1", "bytes": 1024}) == []
+    assert validate_event({"event": "rotate"})  # segment is required
+    assert validate_event({"event": "overlap", "step": 1, "player_step": "no"})  # wrong type
+
+
+# -- watchdog per-incident trace dirs ----------------------------------------
+
+
+class _FakeTelem:
+    def __init__(self):
+        self.recs = []
+
+    def emit(self, rec):
+        self.recs.append(rec)
+
+
+def test_watchdog_unique_incident_dirs(tmp_path, monkeypatch):
+    import jax.profiler as prof
+
+    from sheeprl_tpu.resilience.supervisor import HeartbeatWatchdog
+
+    started = []
+    monkeypatch.setattr(prof, "start_trace", lambda d: started.append(d))
+    monkeypatch.setattr(prof, "stop_trace", lambda: None)
+
+    telem = _FakeTelem()
+    wd = HeartbeatWatchdog(
+        stall_s=0.08, poll_s=0.02, trace_s=0.0, trace_dir=str(tmp_path / "xprof_watchdog"), telem=telem
+    )
+    wd.beat(1)
+    wd.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while len(started) < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        wd.beat(2)  # progress resets the stall episode → a second incident can fire
+        while len(started) < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        wd.stop()
+
+    assert len(started) >= 2, "two stall episodes must dump two traces"
+    assert "incident_001" in started[0] and "incident_002" in started[1]
+    assert started[0] != started[1], "repeated stalls must never overwrite a previous trace"
+    stalls = [r for r in telem.recs if r.get("action") == "stall"]
+    assert [r["incident"] for r in stalls[:2]] == [1, 2]
+    assert stalls[0]["trace_dir"] == started[0]
+    assert all(validate_event(r) == [] for r in stalls)
+
+
+# -- prometheus registry + endpoint ------------------------------------------
+
+
+def test_registry_renders_prometheus_text():
+    reg = Registry(prefix="t")
+    reg.counter("reqs_total", "requests").inc(3)
+    reg.gauge("depth", "queue depth").set(7)
+    h = reg.histogram("lat_ms", "latency", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    text = reg.render()
+    assert "# TYPE t_reqs_total counter" in text
+    assert "t_reqs_total 3" in text
+    assert "t_depth 7" in text
+    assert 't_lat_ms_bucket{le="10"} 2' in text  # cumulative
+    assert 't_lat_ms_bucket{le="+Inf"} 4' in text
+    assert "t_lat_ms_count 4" in text
+    # well-formed: every sample line is `name{labels} value`
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        assert name and float(value) is not None
+
+
+def test_histogram_percentile_estimation():
+    from sheeprl_tpu.diag.prometheus import Histogram
+
+    h = Histogram("h", buckets=tuple(float(b) for b in range(1, 101)))
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.percentile(0.5) == pytest.approx(50.0, abs=1.5)
+    assert h.percentile(0.95) == pytest.approx(95.0, abs=1.5)
+    assert h.percentile(0.99) == pytest.approx(99.0, abs=1.5)
+
+
+def test_registry_observe_event_maps_log_and_overlap():
+    reg = Registry()
+    reg.observe_event({"event": "startup", "platform": "cpu", "devices": 4, "rank": 0})
+    reg.observe_event(
+        {
+            "event": "log",
+            "step": 64,
+            "sps": 80.0,
+            "interval_steps": 64,
+            "interval_seconds": 0.8,
+            "throughput": {"mfu": 0.3},
+            "xla": {"compiles_in_interval": 2, "retraces": 1},
+        }
+    )
+    reg.observe_event({"event": "overlap", "step": 64, "queue_depth": 3, "player_stall_frac": 0.25})
+    text = reg.render()
+    assert "sheeprl_up 1" in text
+    assert "sheeprl_sps 80" in text
+    assert "sheeprl_step_time_seconds 0.0125" in text
+    assert "sheeprl_overlap_queue_depth 3" in text
+    assert "sheeprl_xla_compiles_total 2" in text
+    reg.observe_event({"event": "shutdown", "step": 64})
+    assert "sheeprl_up 0" in reg.render()
+
+
+def test_prometheus_http_server_scrape():
+    reg = Registry()
+    reg.gauge("step", "step").set(42)
+    server = start_http_server(reg, port=0, host="127.0.0.1")  # ephemeral port
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{server.port}/metrics", timeout=5) as resp:
+            assert resp.status == 200
+            assert "text/plain" in resp.headers["Content-Type"]
+            body = resp.read().decode()
+        assert "sheeprl_step 42" in body
+    finally:
+        server.stop()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_ppo_smoke_live_metrics_scrape(monkeypatch):
+    """Acceptance: a live /metrics scrape DURING a PPO smoke run returns
+    well-formed Prometheus text including step-time and overlap queue-depth
+    series (PPO's overlap engine is on by default)."""
+    from sheeprl_tpu.cli import run
+
+    port = _free_port()
+    scrapes = []
+    done = threading.Event()
+
+    def scraper():
+        while not done.is_set():
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=1
+                ) as resp:
+                    scrapes.append(resp.read().decode())
+            except OSError:
+                pass
+            time.sleep(0.02)
+
+    thread = threading.Thread(target=scraper, daemon=True)
+    thread.start()
+    try:
+        run(
+            [
+                "exp=ppo",
+                "env=dummy",
+                "env.id=discrete_dummy",
+                "env.num_envs=2",
+                "env.sync_env=True",
+                "env.capture_video=False",
+                "algo.total_steps=64",
+                "algo.rollout_steps=8",
+                "algo.per_rank_batch_size=4",
+                "algo.update_epochs=1",
+                "algo.mlp_keys.encoder=[state]",
+                "algo.cnn_keys.encoder=[]",
+                "algo.dense_units=8",
+                "algo.mlp_layers=1",
+                "algo.run_test=False",
+                "algo.overlap.stats_every_s=0.01",
+                "metric.log_every=1",
+                "metric.log_level=1",
+                f"metric.telemetry.prometheus_port={port}",
+                "metric.telemetry.prometheus_host=127.0.0.1",
+                "buffer.memmap=False",
+                "checkpoint.save_last=False",
+            ]
+        )
+    finally:
+        done.set()
+        thread.join(timeout=5)
+
+    assert scrapes, "no successful scrape while the run was alive"
+    best = max(scrapes, key=len)
+    assert "sheeprl_step_time_seconds" in best
+    assert "sheeprl_overlap_queue_depth" in best
+    assert "sheeprl_sps" in best
+    for line in best.strip().splitlines():  # well-formed exposition text
+        if line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        float(value)
+
+
+# -- serving histograms ------------------------------------------------------
+
+
+def test_serve_stats_percentiles_and_registry():
+    from sheeprl_tpu.serve.batcher import ServeStats
+
+    stats = ServeStats()
+    for _ in range(3):
+        stats.record_submit()
+    stats.record_batch(3, 4, 0.010)
+    for ms in (2.0, 5.0, 50.0):
+        stats.record_done(ms / 1000.0)
+    snap = stats.snapshot()
+    assert snap["p50_ms"] <= snap["p95_ms"] <= snap["p99_ms"]
+    assert snap["p95_ms"] > 0
+    text = stats.registry.render()
+    assert "sheeprl_serve_latency_ms_bucket" in text
+    assert "sheeprl_serve_batch_occupancy_count 1" in text
+    assert "sheeprl_serve_requests_total 3" in text
+
+
+def test_serve_record_schema_includes_p95():
+    from sheeprl_tpu.serve.batcher import ServeStats
+
+    stats = ServeStats()
+    stats.record_submit()
+    stats.record_done(0.004)
+    rec = {"event": "serve", "requests": stats.requests, **stats.snapshot()}
+    assert validate_event(rec) == []
+    assert "p95_ms" in rec
+
+
+# -- bench regression gate ---------------------------------------------------
+
+
+def _bench_wrapper(round_no, parsed, rc=0):
+    return {"n": round_no, "rc": rc, "parsed": parsed}
+
+
+def _write_bench(dirpath, round_no, parsed, rc=0):
+    (dirpath / f"BENCH_r{round_no:02d}.json").write_text(
+        json.dumps(_bench_wrapper(round_no, parsed, rc))
+    )
+
+
+HEALTHY = {
+    "metric": "e2e SPS",
+    "value": 12.0,
+    "unit": "env steps/sec",
+    "vs_baseline": 1.0,
+    "steady_state_sps": 10.0,
+    "platform": "cpu-fallback",
+    "wall_capped": True,
+}
+
+
+def test_bench_compare_flags_synthetic_20pct_regression(tmp_path):
+    _write_bench(tmp_path, 1, HEALTHY)
+    _write_bench(tmp_path, 2, {**HEALTHY, "steady_state_sps": 10.2})
+    _write_bench(tmp_path, 3, {**HEALTHY, "steady_state_sps": 8.16, "value": 12.1})  # -20%
+    records = bench_compare.load_trajectory(tmp_path)
+    report = bench_compare.compare(records, threshold=0.2)
+    assert report["ok"] is False
+    assert any("steady-state SPS" in f for f in report["failures"])
+    # CLI exits nonzero on the regression, zero with --dry-run
+    assert bench_compare.main(["--dir", str(tmp_path)]) == 2
+    assert bench_compare.main(["--dir", str(tmp_path), "--dry-run"]) == 0
+
+
+def test_bench_compare_normalizes_platform_and_failed_rounds(tmp_path):
+    # an accelerator round and a crashed (rc!=0, no parsed) round must not
+    # become the baseline for a cpu-fallback record
+    _write_bench(tmp_path, 1, {**HEALTHY, "platform": "tpu", "steady_state_sps": 500.0})
+    _write_bench(tmp_path, 2, None, rc=124)
+    _write_bench(tmp_path, 3, {**HEALTHY, "steady_state_sps": 9.8})
+    _write_bench(tmp_path, 4, {**HEALTHY, "steady_state_sps": 9.5})  # ~3% off: fine
+    records = bench_compare.load_trajectory(tmp_path)
+    report = bench_compare.compare(records, threshold=0.2)
+    assert report["ok"] is True
+    steady = next(c for c in report["comparisons"] if c["metric"] == "steady_state_sps")
+    assert steady["baseline_best"] == 9.8  # the tpu round was not comparable
+
+
+def test_bench_compare_fails_when_newest_round_is_unusable(tmp_path):
+    # "bench stopped producing data" IS the regression: a crashed newest
+    # round must not let the gate go green by gating the previous round
+    _write_bench(tmp_path, 1, HEALTHY)
+    _write_bench(tmp_path, 2, HEALTHY)
+    _write_bench(tmp_path, 3, None, rc=124)
+    records = bench_compare.load_trajectory(tmp_path)
+    report = bench_compare.compare(records, threshold=0.2)
+    assert report["ok"] is False
+    assert any("no usable record" in f for f in report["failures"])
+
+
+def test_bench_compare_multichip_flip_is_a_regression(tmp_path):
+    _write_bench(tmp_path, 1, HEALTHY)
+    _write_bench(tmp_path, 2, HEALTHY)
+    (tmp_path / "MULTICHIP_r01.json").write_text(json.dumps({"ok": True, "rc": 0}))
+    (tmp_path / "MULTICHIP_r02.json").write_text(json.dumps({"ok": False, "rc": 1}))
+    records = bench_compare.load_trajectory(tmp_path)
+    mc = bench_compare.load_multichip(tmp_path)
+    report = bench_compare.compare(records, threshold=0.2)
+    assert report["ok"] is True
+    report = bench_compare.compare(records, threshold=0.2, multichip=mc)
+    assert report["ok"] is False
+    assert any("multichip" in f for f in report["failures"])
+    # the multichip gate must run even with NO usable BENCH records at all
+    report = bench_compare.compare([], threshold=0.2, multichip=mc)
+    assert report["ok"] is False
+
+
+def test_ckpt_blocks_counts_each_async_save_once():
+    tl = Timeline(
+        [
+            # async save: enqueued (real block) + written (block 0) pair
+            {"event": "ckpt_async", "action": "enqueued", "step": 10, "block_ms": 1500.0, "mode": "async"},
+            {"event": "ckpt_async", "action": "written", "step": 10, "block_ms": 0.0, "mode": "async"},
+            # sync save: only a written event, carrying the real block
+            {"event": "ckpt_async", "action": "written", "step": 20, "block_ms": 2000.0, "mode": "sync"},
+        ]
+    )
+    assert tl.ckpt_blocks() == [(10, 1500.0), (20, 2000.0)]
+    finding = run_detectors(tl)[0]
+    assert finding.code == "ckpt_spike"
+    assert finding.data["saves"] == 2  # not 3: the async pair is one save
+
+
+def test_timeline_tolerates_stepless_log_events(tmp_path):
+    # the sink writes schema-invalid events rather than dropping them; the
+    # doctor must diagnose such streams, not crash on them
+    events = [
+        {"event": "startup", "platform": "cpu", "device_kind": "cpu", "devices": 1, "rank": 0},
+        {"event": "log", "sps": 100.0, "interval_steps": 32, "interval_seconds": 0.3},  # no step
+        {"event": "log", "step": 64, "sps": 90.0, "interval_steps": 32, "interval_seconds": 0.3},
+        {"event": "shutdown", "step": 64},
+    ]
+    _write_jsonl(tmp_path / "run" / "telemetry.jsonl", events)
+    report = diagnose(tmp_path / "run")
+    assert report["last_step"] == 64
+    assert report["healthy"] is True
+
+
+def test_bench_compare_passes_real_repo_trajectory():
+    """The recorded BENCH_r01..r05 / MULTICHIP_r01..r05 trajectory is the
+    fixed point: the gate must pass it (r05 improves on the comparable
+    cpu-fallback rounds; r01 is a different metric, r02 failed)."""
+    records = bench_compare.load_trajectory(REPO)
+    assert len(records) >= 5
+    mc = bench_compare.load_multichip(REPO)
+    report = bench_compare.compare(records, threshold=0.2, multichip=mc)
+    assert report["ok"] is True, report["failures"]
+
+
+def test_doctor_folds_in_bench_gate(tmp_path):
+    run_dir = make_incident_run(tmp_path / "run")
+    _write_bench(tmp_path, 1, HEALTHY)
+    _write_bench(tmp_path, 2, {**HEALTHY, "steady_state_sps": 7.0})  # -30%
+    report = diagnose(run_dir, bench_dir=tmp_path)
+    assert report["bench"]["ok"] is False
+    assert report["healthy"] is False
+    assert "REGRESSION" in render_text(report)
+
+
+def test_doctor_bench_gate_sees_multichip_flip(tmp_path):
+    run_dir = make_incident_run(tmp_path / "run")
+    _write_bench(tmp_path, 1, HEALTHY)
+    _write_bench(tmp_path, 2, HEALTHY)
+    (tmp_path / "MULTICHIP_r01.json").write_text(json.dumps({"ok": True, "rc": 0}))
+    (tmp_path / "MULTICHIP_r02.json").write_text(json.dumps({"ok": False, "rc": 1}))
+    report = diagnose(run_dir, bench_dir=tmp_path)
+    assert report["bench"]["ok"] is False
+    assert any("multichip" in f for f in report["bench"]["failures"])

@@ -1,0 +1,22 @@
+"""scripts/lint.sh — the single lint/gate entry point must stay green on the
+repo itself (host-sync AST lint + bench regression gate in --dry-run), so
+neither check can silently rot out of CI."""
+import subprocess
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_lint_sh_passes_on_repo():
+    proc = subprocess.run(
+        ["bash", str(REPO / "scripts" / "lint.sh")],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, f"lint.sh failed:\n{proc.stdout}\n{proc.stderr}"
+    # the bench gate actually ran and printed its report; the verdict itself
+    # is deliberately NOT asserted — lint.sh runs the gate in --dry-run so a
+    # regression is reported loudly without blocking unrelated CI
+    assert "bench gate over" in proc.stdout
+    assert "verdict:" in proc.stdout
