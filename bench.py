@@ -85,7 +85,14 @@ DREAMER_TOTAL_STEPS = int(os.environ.get("BENCH_DREAMER_STEPS", 16_384))
 PREFLIGHT_BUDGET_DEFAULT_S = 180.0  # shared by the default path and subcommands
 
 
-def _timed_cli_run(args: list, steps: int, baseline_seconds: float, baseline_steps: int, metric: str) -> dict:
+def _timed_cli_run(
+    args: list,
+    steps: int,
+    baseline_seconds: float,
+    baseline_steps: int,
+    metric: str,
+    unit: str = "env steps/sec",
+) -> dict:
     """Run a recipe through the CLI (training output → stderr), timing it and
     accounting for a wall-cap stop: SPS is computed over the steps that
     actually ran (utils/run_info.py records a short stop)."""
@@ -104,7 +111,7 @@ def _timed_cli_run(args: list, steps: int, baseline_seconds: float, baseline_ste
     rec = {
         "metric": metric,
         "value": round(sps, 2),
-        "unit": "env steps/sec",
+        "unit": unit,
         "vs_baseline": round(sps / (baseline_steps / baseline_seconds), 3),
         "elapsed_seconds": round(elapsed, 2),
         "baseline_seconds": baseline_seconds,
@@ -191,6 +198,46 @@ def bench_dreamer_e2e(which: str) -> dict:
 DREAMER_TOTAL_STEPS_REF = 16_384  # the baseline recipe's step count
 
 
+def bench_dreamer_fleet(which: str) -> dict:
+    """The SAME end-to-end Dreamer recipe as :func:`bench_dreamer_e2e`, run
+    through the supervised actor fleet (``algo.fleet.workers``,
+    sheeprl_tpu/fleet/) instead of the in-process env loop. Records under
+    its own unit — ``env steps/sec (fleet)`` — so `bench_compare.py` gates
+    fleet rounds against fleet rounds only; the acceptance bar is that this
+    leg keeps env-steps/s at or above the single-process overlap engine's
+    on the same recipe (the e2e leg is env-bound: BENCH_r05 measured 10.46
+    env-steps/s vs ~1050 grad-steps/s/chip)."""
+    steps = DREAMER_TOTAL_STEPS
+    wall_cap = float(os.environ.get("BENCH_E2E_WALL_S", 950))
+    workers = int(os.environ.get("BENCH_FLEET_WORKERS", 2))
+    num_envs = int(os.environ.get("BENCH_FLEET_ENVS", max(4, workers)))
+    return _timed_cli_run(
+        [
+            f"exp={DREAMER_EXPS[which]}",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            f"env.num_envs={num_envs}",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            f"algo.total_steps={steps}",
+            f"algo.max_wall_time_s={wall_cap}",
+            f"algo.fleet.workers={workers}",
+            f"buffer.size={steps}",
+            "buffer.checkpoint=False",
+            "buffer.memmap=False",
+            "checkpoint.every=0",
+            "checkpoint.save_last=False",
+            "metric.log_level=0",
+        ],
+        steps,
+        DREAMER_BASELINE_SECONDS[which],
+        DREAMER_TOTAL_STEPS_REF,
+        f"Dreamer{which.upper().replace('DV', 'V')} {steps}-step micro-bench policy SPS "
+        f"(same end-to-end recipe through the {workers}-process actor fleet)",
+        unit="env steps/sec (fleet)",
+    )
+
+
 def _run_subprocess_record(argv: list, budget_s: float) -> dict | None:
     """Run `python bench.py <argv>` as a subprocess with a wall-clock budget;
     return the JSON record from its last stdout line, or None on
@@ -247,7 +294,8 @@ def _maybe_force_cpu() -> None:
 
 def main() -> None:
     arg = sys.argv[1] if len(sys.argv) > 1 else ""
-    if arg in RECIPE_EXPS or arg in DREAMER_EXPS or arg == "dv3_step":
+    is_fleet_leg = arg.endswith("_fleet") and arg[: -len("_fleet")] in DREAMER_EXPS
+    if arg in RECIPE_EXPS or arg in DREAMER_EXPS or arg == "dv3_step" or is_fleet_leg:
         if not os.environ.get("BENCH_FORCE_CPU") and not os.environ.get("BENCH_PREFLIGHT_DONE"):
             # standalone subcommand run (the default path already preflighted
             # and marks its subprocesses with BENCH_PREFLIGHT_DONE): probe the
@@ -266,6 +314,8 @@ def main() -> None:
         _emit(bench_recipe(arg))
     elif arg in DREAMER_EXPS:
         _emit(bench_dreamer_e2e(arg))
+    elif arg.endswith("_fleet") and arg[: -len("_fleet")] in DREAMER_EXPS:
+        _emit(bench_dreamer_fleet(arg[: -len("_fleet")]))
     elif arg == "preflight":
         with contextlib.redirect_stdout(sys.stderr):
             rec = bench_preflight()
@@ -377,23 +427,43 @@ def main() -> None:
                 else "cpu forced via BENCH_FORCE_CPU (preflight not the cause); "
                 "this is a host-CPU measurement of the same end-to-end recipe"
             )
+        # opt-in fleet e2e leg (BENCH_FLEET=1): the same recipe through the
+        # supervised actor fleet, recorded under its own unit so the gate
+        # compares fleet rounds against fleet rounds (off by default — it
+        # costs another full e2e budget)
+        fleet_rec = None
+        if os.environ.get("BENCH_FLEET"):
+            fleet_budget = float(os.environ.get("BENCH_FLEET_BUDGET_S", 1100))
+            fleet_rec = _run_subprocess_record(["dv3_fleet"], fleet_budget)
+            if fleet_rec is not None:
+                fleet_rec["preflight_attempts"] = preflight_attempts
+                if cpu_fallback:
+                    fleet_rec["platform"] = "cpu-fallback" if preflight_failed else "cpu-forced"
+                elif pre is not None:
+                    fleet_rec["platform"] = pre.get("platform")
+                    fleet_rec["device_kind"] = pre.get("device_kind", "")
         if e2e_rec is not None:
             e2e_rec["preflight_attempts"] = preflight_attempts
             if not cpu_fallback and pre is not None:
                 e2e_rec["platform"] = pre.get("platform")
                 e2e_rec["device_kind"] = pre.get("device_kind", "")
                 e2e_rec["device"] = pre.get("device")
+            extra = [rec for rec in (step_rec, fleet_rec) if rec is not None]
             if step_rec is not None:
                 # surface the utilization figures on the headline record
                 for key in ("mfu", "model_flops_per_step", "peak_flops_assumed", "peak_flops_basis"):
                     if key in step_rec:
                         e2e_rec[key] = step_rec[key]
-                e2e_rec["extra_metrics"] = [step_rec]
+            if extra:
+                e2e_rec["extra_metrics"] = extra
             _emit(e2e_rec)
         elif step_rec is not None:
             step_rec["e2e_error"] = (
                 "end-to-end leg failed or exceeded its budget; compute-only record promoted"
             )
+            if fleet_rec is not None:
+                # the fleet leg still ran its full budget: keep it gateable
+                step_rec["extra_metrics"] = [fleet_rec]
             if cpu_fallback:
                 # keep the dead-link / forced-CPU cause on the promoted headline too
                 step_rec["platform"] = "cpu-fallback" if preflight_failed else "cpu-forced"
@@ -406,21 +476,22 @@ def main() -> None:
                 )
             _emit(step_rec)
         else:
-            _emit(
-                {
-                    "metric": "DreamerV3 bench",
-                    "value": 0.0,
-                    "unit": "env steps/sec",
-                    "vs_baseline": 0.0,
-                    "preflight_attempts": preflight_attempts,
-                    "error": (
-                        "accelerator preflight failed (device client creation hung — "
-                        "tunnel down?) and the CPU fallback leg also failed (see stderr)"
-                        if cpu_fallback
-                        else "both bench legs failed (see stderr)"
-                    ),
-                }
-            )
+            failure = {
+                "metric": "DreamerV3 bench",
+                "value": 0.0,
+                "unit": "env steps/sec",
+                "vs_baseline": 0.0,
+                "preflight_attempts": preflight_attempts,
+                "error": (
+                    "accelerator preflight failed (device client creation hung — "
+                    "tunnel down?) and the CPU fallback leg also failed (see stderr)"
+                    if cpu_fallback
+                    else "both bench legs failed (see stderr)"
+                ),
+            }
+            if fleet_rec is not None:
+                failure["extra_metrics"] = [fleet_rec]
+            _emit(failure)
 
 
 if __name__ == "__main__":

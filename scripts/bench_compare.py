@@ -23,7 +23,12 @@ Comparison rules (normalization — the trajectory is heterogeneous):
   and `mfu` — each compared only when BOTH sides carry it — within
   ``(1 - threshold)`` of the best comparable prior record;
 * `MULTICHIP_*.json`: the newest record must not flip `ok` to false when
-  any prior round passed.
+  any prior round passed;
+* **extra legs** (`extra_metrics` on a record — the compute-only dv3_step
+  leg, the fleet e2e leg `env steps/sec (fleet)`): every leg of the newest
+  record gates on its OWN unit + platform class against the best comparable
+  prior leg (searched across priors' headline AND extra legs), so a fleet
+  throughput slide is caught even though the headline unit never carried it.
 
 ``--dry-run`` performs the full comparison and prints the report but always
 exits 0 unless the artifacts themselves are unreadable — that keeps the
@@ -101,6 +106,74 @@ def _comparable(newest: Dict[str, Any], prior: Dict[str, Any]) -> bool:
     )
 
 
+def _legs_of(rec: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """A record's extra legs, each inheriting the headline's platform when
+    it carries none of its own (the parent stamped the class)."""
+    out = []
+    for leg in rec.get("extra_metrics") or []:
+        if isinstance(leg, dict) and leg.get("unit"):
+            merged = dict(leg)
+            merged.setdefault("platform", rec.get("platform"))
+            out.append(merged)
+    return out
+
+
+def _gate_fields(
+    report: Dict[str, Any],
+    rec: Dict[str, Any],
+    candidates: List[Dict[str, Any]],
+    threshold: float,
+    src_file: str,
+    unit: Optional[str] = None,
+) -> None:
+    """The GATED_FIELDS gate shared by the headline record and every extra
+    leg: compare ``rec`` against the best candidate per field; a drop of
+    >= threshold fails the report. ``unit`` tags the metric/failure labels
+    for extra legs (None = the headline gate)."""
+    tag = f" [{unit}]" if unit else ""
+    for key, label in GATED_FIELDS:
+        new_val = rec.get(key)
+        baseline = max(
+            (float(c[key]) for c in candidates if c.get(key) is not None), default=None
+        )
+        cmp: Dict[str, Any] = {
+            "metric": f"{key}{tag}",
+            "newest": new_val,
+            "baseline_best": baseline,
+        }
+        if new_val is None or baseline is None or baseline <= 0:
+            cmp["verdict"] = "skipped (missing on one side)"
+        else:
+            ratio = float(new_val) / baseline
+            cmp["ratio"] = round(ratio, 4)
+            # a drop of exactly the threshold counts as a regression
+            if 1.0 - ratio >= threshold - 1e-9:
+                cmp["verdict"] = "REGRESSION"
+                report["ok"] = False
+                report["failures"].append(
+                    f"{label}{tag} regressed {1 - ratio:.0%}: {new_val} vs best prior "
+                    f"{baseline} ({src_file}, threshold {threshold:.0%})"
+                )
+            else:
+                cmp["verdict"] = "ok"
+        report["comparisons"].append(cmp)
+
+
+def _gate_extra_legs(report: Dict[str, Any], newest: Dict[str, Any], priors_all: List[Dict[str, Any]], threshold: float) -> None:
+    """Gate every extra leg of the newest record on its own unit+platform
+    class; baselines are searched across prior headlines AND extra legs."""
+    for leg in _legs_of(newest):
+        unit, plat = leg.get("unit"), platform_class(leg)
+        candidates: List[Dict[str, Any]] = []
+        for prior in priors_all:
+            if not prior["_usable"]:
+                continue
+            for cand in [prior] + _legs_of(prior):
+                if cand.get("unit") == unit and platform_class(cand) == plat:
+                    candidates.append(cand)
+        _gate_fields(report, leg, candidates, threshold, newest["_file"], unit=unit)
+
+
 def compare(
     records: List[Dict[str, Any]],
     threshold: float = 0.2,
@@ -137,28 +210,9 @@ def compare(
                 f"no comparable prior record (unit={newest.get('unit')!r}, "
                 f"platform class={platform_class(newest)!r}) — nothing to gate against"
             )
-        for key, label in GATED_FIELDS:
-            new_val = newest.get(key)
-            baseline = max(
-                (float(r[key]) for r in priors if r.get(key) is not None), default=None
-            )
-            cmp: Dict[str, Any] = {"metric": key, "newest": new_val, "baseline_best": baseline}
-            if new_val is None or baseline is None or baseline <= 0:
-                cmp["verdict"] = "skipped (missing on one side)"
-            else:
-                ratio = float(new_val) / baseline
-                cmp["ratio"] = round(ratio, 4)
-                # a drop of exactly the threshold counts as a regression
-                if 1.0 - ratio >= threshold - 1e-9:
-                    cmp["verdict"] = "REGRESSION"
-                    report["ok"] = False
-                    report["failures"].append(
-                        f"{label} regressed {1 - ratio:.0%}: {new_val} vs best prior "
-                        f"{baseline} ({newest['_file']}, threshold {threshold:.0%})"
-                    )
-                else:
-                    cmp["verdict"] = "ok"
-            report["comparisons"].append(cmp)
+        _gate_fields(report, newest, priors, threshold, newest["_file"])
+        # per-unit extra legs (dv3_step compute-only, fleet e2e, ...)
+        _gate_extra_legs(report, newest, usable[:-1], threshold)
 
     # the multichip gate runs even with no (usable) BENCH records — a
     # MULTICHIP-only trajectory still has an ok→fail flip to catch

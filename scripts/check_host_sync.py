@@ -11,8 +11,11 @@ flush); this AST check keeps them purged — it fails on NEW syncs.
 Scope (deliberately narrow, to stay precise):
 
 * functions decorated with ``@register_algorithm`` (the train loops) and
-  functions whose name ends with ``_loop`` (decoupled player loops) in the
-  given files/dirs (default: ``sheeprl_tpu/algos``);
+  functions whose name ends with ``_loop`` (decoupled player loops, the
+  fleet worker loop) in the given files/dirs (default:
+  ``sheeprl_tpu/algos`` + ``sheeprl_tpu/fleet`` — the worker step path must
+  stay host-sync clean too: a hidden sync there stalls every env slice the
+  worker owns);
 * only statements inside a ``while``/``for`` loop in those functions — the
   hot path, not setup code.
 
@@ -178,7 +181,10 @@ def check_paths(paths: List[Path]) -> List[Tuple[Path, int, str]]:
 
 def main(argv: List[str]) -> int:
     repo = Path(__file__).resolve().parent.parent
-    paths = [Path(a) for a in argv] or [repo / "sheeprl_tpu" / "algos"]
+    paths = [Path(a) for a in argv] or [
+        repo / "sheeprl_tpu" / "algos",
+        repo / "sheeprl_tpu" / "fleet",
+    ]
     violations = check_paths(paths)
     for path, lineno, msg in violations:
         print(f"{path}:{lineno}: {msg}", file=sys.stderr)

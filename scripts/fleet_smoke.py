@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Actor-fleet smoke test: a REAL worker process SIGKILLed mid-run.
+
+The tier-1 fleet tests inject faults through the chaos layer
+(`resilience.chaos.*` — a scripted `os._exit` inside the worker). This
+script is the harder, outside-in variant: the fault comes from the OS, not
+from the worker's own schedule, so it proves the supervision tree against a
+genuinely external kill (the OOM-killer / a node agent), end to end:
+
+1. spawn `sheeprl_tpu run exp=sac ... algo.fleet.workers=2` as a child
+   process;
+2. follow the run's telemetry.jsonl for the fleet `spawn` events (they
+   carry each worker's pid) and the first `interval` heartbeat (steady
+   state — workers up, rounds flowing);
+3. `SIGKILL` one worker process — no warning, no cleanup;
+4. wait for the run to finish and assert: the child exits 0, telemetry
+   records the crash AND a respawn of the same worker slot, the final
+   checkpoint carries the full configured step count (no env steps lost to
+   the murder), and `doctor` surfaces the incident as a fleet finding
+   (a single kill reads as `fleet_degraded` — the respawn's startup window
+   ran below strength; `worker_flap` needs repeated faults by design).
+
+Prints one JSON verdict line on stdout (`{"ok": true, ...}`), exit code 0
+on success — the contract `tests/test_fleet.py::test_fleet_smoke_script_*`
+(slow marker) checks. Run it from any scratch directory:
+
+    JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+TOTAL_STEPS = 1024
+RUN_NAME = "fleet_smoke"
+BASE = pathlib.Path("logs/runs/sac/continuous_dummy") / RUN_NAME
+
+TRAIN_ARGS = [
+    "exp=sac",
+    "env=dummy",
+    "env.id=continuous_dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "metric.log_level=1",
+    f"algo.total_steps={TOTAL_STEPS}",
+    "algo.learning_starts=16",
+    "algo.per_rank_batch_size=4",
+    "algo.hidden_size=8",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.run_test=False",
+    "algo.fleet.workers=2",
+    "buffer.size=4096",
+    "buffer.memmap=False",
+    "buffer.checkpoint=True",
+    "checkpoint.every=0",
+    "checkpoint.save_last=True",
+    "model_manager.disabled=True",
+    "seed=5",
+    f"run_name={RUN_NAME}",
+    "fleet.backoff_s=0.1",
+    "fleet.stats_every_s=0.5",
+]
+
+
+def _fail(msg, **extra):
+    print(json.dumps({"ok": False, "error": msg, **extra}))
+    sys.exit(1)
+
+
+def _events(telem: pathlib.Path):
+    if not telem.is_file():
+        return []
+    out = []
+    for ln in telem.read_text().splitlines():
+        try:
+            out.append(json.loads(ln))
+        except ValueError:
+            pass  # torn tail line of a live file
+    return out
+
+
+def _fleet(events, action):
+    return [e for e in events if e.get("event") == "fleet" and e.get("action") == action]
+
+
+def main() -> None:
+    # -- spawn the fleet run ----------------------------------------------
+    child = subprocess.Popen(
+        [sys.executable, "-m", "sheeprl_tpu", "run", *TRAIN_ARGS],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT,
+        env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
+    )
+    telem = BASE / "version_0" / "telemetry.jsonl"
+
+    # -- wait for steady state, pick a victim -----------------------------
+    victim_pid = victim_worker = None
+    deadline = time.monotonic() + 600
+    while time.monotonic() < deadline:
+        if child.poll() is not None:
+            _fail("run exited before steady state", rc=child.returncode)
+        events = _events(telem)
+        spawns = _fleet(events, "spawn")
+        # steady state = rounds are flowing (first periodic interval event)
+        if spawns and _fleet(events, "interval"):
+            victim = spawns[0]
+            victim_pid, victim_worker = int(victim["pid"]), int(victim["worker"])
+            break
+        time.sleep(0.25)
+    if victim_pid is None:
+        child.kill()
+        _fail("no fleet spawn + interval events within 600s")
+
+    # -- the murder: external SIGKILL, no warning -------------------------
+    try:
+        os.kill(victim_pid, signal.SIGKILL)
+    except ProcessLookupError:
+        _fail("victim worker was already gone", pid=victim_pid)
+    t_kill = time.time()  # events stamp wall-clock `t`
+
+    # -- the run must finish anyway ---------------------------------------
+    try:
+        rc = child.wait(timeout=900)
+    except subprocess.TimeoutExpired:
+        child.kill()
+        _fail("run did not finish within 900s of the worker kill")
+    if rc != 0:
+        _fail("run failed after worker kill", rc=rc)
+
+    events = _events(telem)
+    crashes = [e for e in _fleet(events, "crash") if e.get("worker") == victim_worker]
+    respawns = [e for e in _fleet(events, "respawn") if e.get("worker") == victim_worker]
+    if not crashes:
+        _fail("telemetry recorded no crash for the killed worker")
+    if not respawns:
+        _fail("killed worker was never respawned")
+    # SIGKILL is exit code -9 on the process object
+    if crashes[0].get("exitcode") not in (-9, 137):
+        _fail("crash exitcode does not look like a SIGKILL", crash=crashes[0])
+
+    ckpts = sorted(
+        (BASE / "version_0" / "checkpoint").glob("ckpt_*.ckpt"),
+        key=lambda p: int(p.stem.split("_")[1]),
+    )
+    if not ckpts:
+        _fail("no final checkpoint")
+    final_step = int(ckpts[-1].stem.split("_")[1])
+    if final_step != TOTAL_STEPS:
+        _fail("final checkpoint short of total_steps", final_step=final_step)
+
+    # -- doctor must surface the incident ---------------------------------
+    from sheeprl_tpu.diag.findings import run_detectors
+    from sheeprl_tpu.diag.timeline import Timeline, iter_events
+
+    tl = Timeline(list(iter_events(telem)))
+    codes = [f.code for f in run_detectors(tl)]
+
+    print(
+        json.dumps(
+            {
+                "ok": True,
+                "victim_worker": victim_worker,
+                "victim_pid": victim_pid,
+                "respawn_s": round(
+                    max(0.0, float(respawns[0].get("t") or t_kill) - t_kill), 2
+                ),
+                "final_step": final_step,
+                "crash_exitcode": crashes[0].get("exitcode"),
+                "doctor_findings": codes,
+                "incident_found": bool(
+                    {"fleet_degraded", "worker_flap", "quarantine"} & set(codes)
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -2,7 +2,8 @@
 # Single lint/gate entry point, wired into tier-1 (tests/test_lint.py) so
 # neither check can silently rot:
 #   * scripts/check_host_sync.py — the AST lint against hidden device→host
-#     syncs in the training hot loops;
+#     syncs in the training hot loops (sheeprl_tpu/algos) AND the fleet
+#     worker step path (sheeprl_tpu/fleet — its default scan set);
 #   * scripts/bench_compare.py --dry-run — the bench regression gate run
 #     over the repo's recorded BENCH_*/MULTICHIP_* trajectory (full
 #     comparison + report; --dry-run keeps a slower CI host from failing

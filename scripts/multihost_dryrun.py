@@ -41,14 +41,16 @@ DEVICES_PER_PROC = 4
 
 
 def child(rank: int, port: str, workdir: str) -> None:
+    # XLA_FLAGS (host platform device count) is set by the PARENT in this
+    # process's environment before the interpreter started — mutating it
+    # here, after `import jax`, would be too late for the CPU client.
+    assert f"--xla_force_host_platform_device_count={DEVICES_PER_PROC}" in os.environ.get(
+        "XLA_FLAGS", ""
+    ), "run via the parent: it must export XLA_FLAGS before spawning children"
     # the axon sitecustomize pins jax_platforms; override AFTER import
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + f" --xla_force_host_platform_device_count={DEVICES_PER_PROC}"
-    )
     jax.distributed.initialize(
         f"127.0.0.1:{port}", num_processes=N_PROCESSES, process_id=rank
     )
@@ -109,6 +111,14 @@ def main() -> None:
         port = str(s.getsockname()[1])
     t0 = time.perf_counter()
     budget = float(os.environ.get("MULTIHOST_BUDGET_S", 240))
+    # the virtual-device flag must be in the child's environment BEFORE its
+    # interpreter starts: XLA reads it when the CPU client is created, so an
+    # os.environ mutation after `import jax` inside child() is a no-op
+    child_env = dict(os.environ)
+    child_env["XLA_FLAGS"] = (
+        child_env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={DEVICES_PER_PROC}"
+    ).strip()
     with tempfile.TemporaryDirectory() as workdir:
         procs = [
             subprocess.Popen(
@@ -117,6 +127,7 @@ def main() -> None:
                 stderr=subprocess.STDOUT,
                 text=True,
                 cwd=REPO,
+                env=child_env,
             )
             for r in range(N_PROCESSES)
         ]

@@ -33,6 +33,7 @@ from ...config import Config, instantiate
 from ...data import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from ...data.device_ring import estimate_row_bytes, make_sequential_prefetcher
 from ...engine import BufferOpSink, OverlapEngine, Packet, RecordingSink
+from ...fleet import FleetEngine
 from ...distributions import (
     BernoulliSafeMode,
     Independent,
@@ -49,7 +50,7 @@ from ...parallel.placement import make_param_mirror, player_device
 from ...telemetry import Telemetry
 from ...telemetry import xla as _xla
 from ...utils.checkpoint import CheckpointManager
-from ...utils.env import episode_stats, patch_restarted_envs, vectorize
+from ...utils.env import episode_stats, patch_restarted_envs, probe_env_spaces, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
@@ -505,10 +506,16 @@ def main(dist: Distributed, cfg: Config) -> None:
         save_configs(cfg, log_dir)
 
     # crash-prone suites restart in place; the loop patches the buffer via
-    # patch_restarted_envs (reference dreamer_v3.py:385-399)
-    envs = vectorize(cfg, cfg.seed, rank, log_dir, restart_handled_by_loop=True)
-    obs_space = envs.single_observation_space
-    action_space = envs.single_action_space
+    # patch_restarted_envs (reference dreamer_v3.py:385-399). Fleet mode
+    # (algo.fleet.workers > 0): env stepping lives in supervised worker
+    # PROCESSES (sheeprl_tpu/fleet/) — the learner only probes the spaces.
+    if FleetEngine.configured(cfg):
+        envs = None
+        obs_space, action_space = probe_env_spaces(cfg, cfg.seed, rank)
+    else:
+        envs = vectorize(cfg, cfg.seed, rank, log_dir, restart_handled_by_loop=True)
+        obs_space = envs.single_observation_space
+        action_space = envs.single_action_space
     num_envs = int(cfg.env.num_envs)
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
@@ -595,18 +602,19 @@ def main(dist: Distributed, cfg: Config) -> None:
     )
     pending_metrics: list = []
 
-    obs, _ = envs.reset(seed=cfg.seed)
-    player_state = player_init(mirror.params)
+    if envs is not None:
+        obs, _ = envs.reset(seed=cfg.seed)
+        player_state = player_init(mirror.params)
 
-    # row 0: reset obs, zero action/reward, is_first=1 (reference :536-549)
-    step_data: Dict[str, np.ndarray] = {}
-    for k in obs_keys:
-        step_data[k] = np.asarray(obs[k])[np.newaxis]
-    step_data["actions"] = np.zeros((1, num_envs, act_total), np.float32)
-    step_data["rewards"] = np.zeros((1, num_envs, 1), np.float32)
-    step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
-    step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
-    step_data["is_first"] = np.ones((1, num_envs, 1), np.float32)
+        # row 0: reset obs, zero action/reward, is_first=1 (reference :536-549)
+        step_data: Dict[str, np.ndarray] = {}
+        for k in obs_keys:
+            step_data[k] = np.asarray(obs[k])[np.newaxis]
+        step_data["actions"] = np.zeros((1, num_envs, act_total), np.float32)
+        step_data["rewards"] = np.zeros((1, num_envs, 1), np.float32)
+        step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
+        step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
+        step_data["is_first"] = np.ones((1, num_envs, 1), np.float32)
 
     def _ckpt_state() -> Dict[str, Any]:
         s: Dict[str, Any] = {
@@ -738,7 +746,56 @@ def main(dist: Distributed, cfg: Config) -> None:
     engine = OverlapEngine.setup(
         cfg, telem, guard, total_steps=total_steps, initial_step=policy_step
     )
-    if engine.enabled:
+    fleet = FleetEngine.setup(
+        cfg, telem, guard, total_steps=total_steps, initial_step=policy_step
+    )
+    if fleet.enabled:
+        # ---- supervised actor-fleet loop (sheeprl_tpu/fleet/): worker
+        # processes run the recurrent player against published {wm, actor}
+        # snapshots; each worker's ops replay against its own global env
+        # columns of the per-env sequential buffer (apply_sliced), so a
+        # quarantined slice simply stops growing. One round per num_envs
+        # quantum keeps the Ratio ledger identical to the serial loop's.
+        fleet.start("sheeprl_tpu.fleet.programs:dreamer_v3_program", num_envs, cfg)
+        fleet.publish(mirror.current())
+        stopped = False
+        while policy_step < total_steps:
+            telem.tick(policy_step)
+            if guard.stop_reached(policy_step, total_steps, None, save=False):
+                stopped = True
+                break
+            with telem.span("Time/env_interaction_time"):
+                rnd = fleet.take_round(policy_step)
+            if rnd is None:
+                break
+            fleet.apply_sliced(rnd, rb, aggregator)
+            policy_step += rnd.env_steps
+            g = 0
+            if policy_step >= learning_starts:
+                g = ratio(policy_step / dist.world_size)
+                telem.record_grad_steps(g)
+            if g > 0:
+                with telem.span("Time/train_time"):
+                    batches = prefetch.take(g)  # [G, T, B, ...]
+                    root_key, sub = jax.random.split(root_key)
+                    params, opt_states, moments, metrics = train(
+                        params, opt_states, moments, batches, jax.random.split(sub, g)
+                    )
+                if not MetricAggregator.disabled:
+                    pending_metrics.append(metrics)
+                mirror.refresh({"wm": params["wm"], "actor": params["actor"]})
+                fleet.publish(mirror.current())
+                run_info.mark_steady(policy_step, sync=lambda: jax.block_until_ready(metrics))
+            if learning_starts <= policy_step < total_steps:
+                # same guard as the serial loop: staging before training can
+                # start would pay a host sample that take() can never use
+                prefetch.stage(ratio.peek((policy_step + rnd.env_steps) / dist.world_size))
+            flush_logs()
+            maybe_checkpoint()
+        policy_step += fleet.shutdown(lambda r: fleet.apply_sliced(r, rb, aggregator))
+        if (stopped or policy_step < total_steps) and not guard.preempted and cfg.checkpoint.save_last:
+            ckpt.save(policy_step, _ckpt_state())
+    elif engine.enabled:
         # ---- overlapped player/learner loop (engine/overlap.py) ----------
         def play() -> Packet:
             rec = RecordingSink()
@@ -873,7 +930,8 @@ def main(dist: Distributed, cfg: Config) -> None:
             maybe_checkpoint()
 
     guard.close(policy_step, _ckpt_state)
-    envs.close()
+    if envs is not None:
+        envs.close()
     telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:
         test_cfg = Config({**cfg.to_dict(), "env": {**cfg.env.to_dict(), "num_envs": 1}})

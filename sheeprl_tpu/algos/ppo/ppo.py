@@ -32,12 +32,14 @@ import optax
 from ...config import Config, instantiate
 from ...data import ReplayBuffer
 from ...engine import OverlapEngine, Packet
+from ...fleet import FleetEngine
+from ...fleet.programs import merge_ppo_round
 from ...ops import gae as gae_op
 from ...optim import clipped
 from ...parallel import Distributed
 from ...parallel.placement import make_param_mirror
 from ...utils.checkpoint import CheckpointManager
-from ...utils.env import episode_stats, vectorize
+from ...utils.env import episode_stats, probe_env_spaces, vectorize
 from ...telemetry import Telemetry
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.registry import register_algorithm, register_evaluation
@@ -137,9 +139,16 @@ def main(dist: Distributed, cfg: Config) -> None:
     if rank == 0:
         save_configs(cfg, log_dir)
 
-    envs = vectorize(cfg, cfg.seed, rank, log_dir)
-    obs_space = envs.single_observation_space
-    action_space = envs.single_action_space
+    # fleet mode (algo.fleet.workers > 0): rollout collection lives in
+    # supervised worker PROCESSES (sheeprl_tpu/fleet/) — one rollout slice
+    # per worker per publication, merged full-width learner-side
+    if FleetEngine.configured(cfg):
+        envs = None
+        obs_space, action_space = probe_env_spaces(cfg, cfg.seed, rank)
+    else:
+        envs = vectorize(cfg, cfg.seed, rank, log_dir)
+        obs_space = envs.single_observation_space
+        action_space = envs.single_action_space
     num_envs = int(cfg.env.num_envs)
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
@@ -204,7 +213,8 @@ def main(dist: Distributed, cfg: Config) -> None:
     last_log = state["last_log"] if state else 0
     last_checkpoint = state["last_checkpoint"] if state else 0
 
-    obs, _ = envs.reset(seed=cfg.seed)
+    if envs is not None:
+        obs, _ = envs.reset(seed=cfg.seed)
 
     def _ckpt_state():
         # `completed_update` = the last update whose params this checkpoint
@@ -351,9 +361,58 @@ def main(dist: Distributed, cfg: Config) -> None:
         initial_step=policy_step,
         default_queue_depth=1,  # at most one rollout ahead of the learner
     )
+    fleet = FleetEngine.setup(
+        cfg,
+        telem,
+        guard,
+        total_steps=num_updates * policy_steps_per_iter,
+        initial_step=policy_step,
+    )
     update_iter = start_iter
     completed_update = start_iter - 1
-    if engine.enabled:
+    if fleet.enabled:
+        # ---- supervised actor-fleet loop (sheeprl_tpu/fleet/): each worker
+        # collects ONE rollout slice per param publication (strict on-policy
+        # round protocol — the fleet twin of the overlap engine's
+        # staleness_bound=0 mode), merged full-width learner-side. A
+        # quarantined worker's columns are backfilled by duplicating
+        # surviving slices so the jitted update's shapes never change.
+        fleet.start("sheeprl_tpu.fleet.programs:ppo_program", num_envs, cfg)
+        fleet.publish(mirror.current())  # v1 releases the first rollouts
+        stopped = False
+        while update_iter <= num_updates:
+            telem.tick(policy_step)
+            if guard.stop_reached(policy_step, int(cfg.algo.total_steps), None, save=False):
+                stopped = True
+                break
+            with telem.span("Time/env_interaction_time"):
+                # strict protocol: only rollouts acted with the NEWEST
+                # publication merge; a post-crash duplicate for an older
+                # version is dropped, not silently trained on
+                rnd = fleet.take_round(policy_step, min_version=fleet.pub_version)
+            if rnd is None:
+                break
+            local, next_value, ep_stats = merge_ppo_round(rnd, fleet.workers)
+            policy_step += rnd.env_steps
+            record_ep_stats(ep_stats)
+            with telem.span("Time/train_time"):
+                metrics = update_from(local, next_value, update_iter)
+                mirror.refresh(params)  # blocking: the next rollouts act with these
+                fleet.publish(mirror.current())  # releases the parked workers
+                run_info.mark_steady(policy_step)
+            completed_update = update_iter
+            if aggregator is not None:
+                for k, v in metrics.items():
+                    aggregator.update(k, np.asarray(v))  # host-sync: ok (update cadence)
+            flush_logs()
+            maybe_checkpoint(update_iter)
+            update_iter += 1
+        # queued rollouts (collected for params that will never act again)
+        # are dropped — PPO keeps no cross-update buffer, same as overlap
+        fleet.shutdown()
+        if (stopped or update_iter <= num_updates) and not guard.preempted and cfg.checkpoint.save_last:
+            ckpt.save(policy_step, _ckpt_state())
+    elif engine.enabled:
         # ---- overlapped rollout/update loop (engine/overlap.py): the
         # player collects rollout k+1 against the pre-update mirror snapshot
         # (staleness = one update; the clipped surrogate absorbs it) while
@@ -441,7 +500,8 @@ def main(dist: Distributed, cfg: Config) -> None:
                 break
 
     guard.close(policy_step, _ckpt_state)
-    envs.close()
+    if envs is not None:
+        envs.close()
     telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:
         test_env = vectorize(

@@ -29,11 +29,12 @@ from ...config import Config, instantiate
 from ...data import ReplayBuffer
 from ...data.device_ring import estimate_row_bytes, make_uniform_prefetcher
 from ...engine import BufferOpSink, OverlapEngine, Packet, RecordingSink
+from ...fleet import FleetEngine
 from ...parallel import Distributed
 from ...parallel.placement import make_param_mirror
 from ...telemetry import Telemetry
 from ...utils.checkpoint import CheckpointManager
-from ...utils.env import episode_stats, vectorize
+from ...utils.env import episode_stats, probe_env_spaces, vectorize
 from ...utils.logger import get_log_dir, get_logger
 from ...utils.metric import MetricAggregator
 from ...utils.registry import register_algorithm, register_evaluation
@@ -131,9 +132,16 @@ def main(dist: Distributed, cfg: Config) -> None:
     if rank == 0:
         save_configs(cfg, log_dir)
 
-    envs = vectorize(cfg, cfg.seed, rank, log_dir)
-    obs_space = envs.single_observation_space
-    action_space = envs.single_action_space
+    # fleet mode (algo.fleet.workers > 0): env stepping lives in supervised
+    # worker PROCESSES (sheeprl_tpu/fleet/) — the learner only needs the
+    # spaces to build the agent, never its own vector env
+    if FleetEngine.configured(cfg):
+        envs = None
+        obs_space, action_space = probe_env_spaces(cfg, cfg.seed, rank)
+    else:
+        envs = vectorize(cfg, cfg.seed, rank, log_dir)
+        obs_space = envs.single_observation_space
+        action_space = envs.single_action_space
     num_envs = int(cfg.env.num_envs)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     if not isinstance(action_space, gym.spaces.Box):
@@ -216,8 +224,9 @@ def main(dist: Distributed, cfg: Config) -> None:
         cfg, dist.local_device, {"actor": params["actor"]}, root_key
     )
 
-    obs, _ = envs.reset(seed=cfg.seed)
-    obs_vec = flatten_obs(obs, mlp_keys, num_envs)
+    if envs is not None:
+        obs, _ = envs.reset(seed=cfg.seed)
+        obs_vec = flatten_obs(obs, mlp_keys, num_envs)
 
     def _ckpt_state():
         s = {
@@ -303,7 +312,64 @@ def main(dist: Distributed, cfg: Config) -> None:
     engine = OverlapEngine.setup(
         cfg, telem, guard, total_steps=total_steps, initial_step=policy_step
     )
-    if engine.enabled:
+    fleet = FleetEngine.setup(
+        cfg, telem, guard, total_steps=total_steps, initial_step=policy_step
+    )
+    if fleet.enabled:
+        # ---- supervised actor-fleet loop (sheeprl_tpu/fleet/) ------------
+        # N worker processes step the env slices and stream RecordingSink
+        # packets; one ROUND (one packet per active worker, FIFO-merged in
+        # worker order) is the serial loop's num_envs quantum, so the Ratio
+        # ledger below is fed with exactly the serial call sequence.
+        fleet.start("sheeprl_tpu.fleet.programs:sac_program", num_envs, cfg)
+        fleet.publish(mirror.current())  # v1: workers act with these params
+        stopped = False
+        while policy_step < total_steps:
+            telem.tick(policy_step)
+            if guard.stop_reached(policy_step, total_steps, None, save=False):
+                stopped = True
+                break
+            with telem.span("Time/env_interaction_time"):
+                rnd = fleet.take_round(policy_step)
+            if rnd is None:
+                break
+            fleet.apply_concat(rnd, rb, aggregator, validate=cfg.buffer.validate_args)
+            policy_step += rnd.env_steps
+            g = 0
+            if policy_step >= learning_starts:
+                g = ratio(policy_step / dist.world_size)
+                telem.record_grad_steps(g)
+            if g > 0:
+                with telem.span("Time/train_time"):
+                    batches = prefetch.take(g)  # [G, B, ...]
+                    root_key, sub = jax.random.split(root_key)
+                    params, opt_states, metrics = train(
+                        params, opt_states, batches, jax.random.split(sub, g)
+                    )
+                    cumulative_grad_steps += g
+                if not MetricAggregator.disabled:
+                    pending_metrics.append(metrics)
+                # ParamMirror → fleet publication: the same snapshot path
+                # the overlap engine and serve/reload share
+                mirror.refresh({"actor": params["actor"]})
+                fleet.publish(mirror.current())
+                run_info.mark_steady(policy_step, sync=lambda: jax.block_until_ready(metrics))
+            if learning_starts <= policy_step < total_steps:
+                # same guard as the serial loop: staging before training can
+                # start would pay a host sample that take() can never use
+                prefetch.stage(ratio.peek((policy_step + rnd.env_steps) / dist.world_size))
+            flush_logs()
+            maybe_checkpoint()
+        # drain: every COMPLETE queued round lands in the buffer so the
+        # final checkpoint is consistent (ratio catches up at resume)
+        policy_step += fleet.shutdown(
+            lambda r: fleet.apply_concat(r, rb, aggregator, validate=cfg.buffer.validate_args)
+        )
+        # an early exit (wall cap / whole-fleet quarantine halt) still
+        # leaves a resumable checkpoint; preemption saves through the guard
+        if (stopped or policy_step < total_steps) and not guard.preempted and cfg.checkpoint.save_last:
+            ckpt.save(policy_step, _ckpt_state())
+    elif engine.enabled:
         # ---- overlapped player/learner loop (engine/overlap.py) ----------
         def play() -> Packet:
             rec = RecordingSink()
@@ -393,7 +459,8 @@ def main(dist: Distributed, cfg: Config) -> None:
             maybe_checkpoint()
 
     guard.close(policy_step, _ckpt_state)
-    envs.close()
+    if envs is not None:
+        envs.close()
     telem.close(policy_step)
     if rank == 0 and cfg.algo.run_test:
         test_env = vectorize(

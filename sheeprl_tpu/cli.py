@@ -321,6 +321,15 @@ def registration(args: Optional[Sequence[str]] = None) -> None:
     if ckpt is None:
         raise ValueError("registration requires `checkpoint_path=<path to .ckpt>`")
     if backend == "mlflow":
+        # the remote registry takes no per-model CLI overrides: refusing the
+        # leftovers beats the local backend consuming them and mlflow
+        # silently dropping them (divergent behavior per backend)
+        if rest:
+            raise ValueError(
+                f"backend=mlflow does not accept extra overrides, got {rest}; "
+                "model selection/labels come from the experiment config "
+                "(MODELS_TO_REGISTER) — drop the extra arguments or use backend=local"
+            )
         from .utils.mlflow_registry import register_models_from_checkpoint_remote
 
         register_models_from_checkpoint_remote(pathlib.Path(ckpt))

@@ -296,11 +296,25 @@ class _ShardedRing(_StagedGather):
     """Shared mechanics of the dp-sharded ring variants: per-device shard
     prefetchers built by the subclass, batches assembled pre-sharded with
     :func:`jax.make_array_from_single_device_arrays` along the batch axis
-    the subclass names (2 for sequential [G, T, B], 1 for uniform [G, B])."""
+    the subclass names (2 for sequential [G, T, B], 1 for uniform [G, B]).
+
+    Warmup: each shard samples only its own env block, so early in a run one
+    device's block can have no ready sub-buffer while others already do —
+    the per-shard gather then raises. With a host fallback attached (the
+    factories pass the same host sample fn the non-ring path would use) the
+    batch is served host-staged until every block has data; without one the
+    error surfaces with the warmup context spelled out."""
 
     _batch_axis: int  # set by subclasses
     _shards: List[Any]
     _batch_sharding: Any
+    _fallback: Optional[Any] = None  # host sample fn: g -> host [G, ...] batch
+    _warned_warmup: bool = False
+    _ring_served = False  # at least one successful sharded gather
+
+    def attach_fallback(self, sample_fn: Any) -> "_ShardedRing":
+        self._fallback = sample_fn
+        return self
 
     @property
     def ring(self) -> Optional[List[Dict[str, jax.Array]]]:
@@ -313,7 +327,37 @@ class _ShardedRing(_StagedGather):
 
     def _gather(self, g: int) -> Any:
         ax = self._batch_axis
-        parts = [s._gather(g) for s in self._shards]
+        try:
+            parts = [s._gather(g) for s in self._shards]
+        except ValueError as err:
+            # one device block has no ready sub-buffer yet (warmup) — but
+            # once the ring has served a batch, a gather ValueError is a
+            # real bug, not a warmup hole: never silently downgrade the run
+            if self._ring_served or self._fallback is None:
+                raise ValueError(
+                    "sharded device ring gather failed"
+                    + (
+                        " AFTER the ring had already served (not a warmup hole)"
+                        if self._ring_served
+                        else ": a device's env block has no ready sub-buffer yet "
+                        "(warmup) and no host fallback is attached"
+                    )
+                    + f"; underlying error: {err}"
+                ) from err
+            if not self._warned_warmup:
+                self._warned_warmup = True
+                import sys
+
+                print(
+                    "[device_ring] warmup: not every device block has replay data "
+                    "yet; serving host-staged batches until the sharded ring is "
+                    f"ready (shard gather: {err})",
+                    file=sys.stderr,
+                )
+            return jax.tree.map(
+                lambda x: jax.device_put(x, self._batch_sharding), self._fallback(g)
+            )
+        self._ring_served = True
         out: Dict[str, jax.Array] = {}
         for k in parts[0]:
             shards = [p[k] for p in parts]
@@ -698,6 +742,13 @@ def make_sequential_prefetcher(
     supported = isinstance(rb, EnvIndependentReplayBuffer) and all(
         isinstance(b, SequentialReplayBuffer) for b in rb.buffer
     )
+    if host_sample_fn is None:
+        def host_sample_fn(g):  # noqa: F811 — default sequential host sample
+            s = rb.sample(batch_size, sequence_length=sequence_length, n_samples=g)
+            return {
+                k: np.asarray(v) if k in cnn_keys else np.asarray(v, np.float32)
+                for k, v in s.items()
+            }
     if supported and _use_ring(
         cfg, dist, row_bytes_hint, rb.buffer_size * rb.n_envs, multi_ok=True
     ):
@@ -712,14 +763,9 @@ def make_sequential_prefetcher(
             ),
         )
         if sharded is not None:
-            return sharded
-    if host_sample_fn is None:
-        def host_sample_fn(g):  # noqa: F811 — default sequential host sample
-            s = rb.sample(batch_size, sequence_length=sequence_length, n_samples=g)
-            return {
-                k: np.asarray(v) if k in cnn_keys else np.asarray(v, np.float32)
-                for k, v in s.items()
-            }
+            # warmup hole: a device block with no ready sub-buffer serves
+            # host-staged batches instead of raising (satellite ADVICE r5)
+            return sharded.attach_fallback(host_sample_fn)
     return StagedPrefetcher(host_sample_fn, dist.sharding(None, None, "dp"))
 
 
@@ -737,6 +783,13 @@ def make_uniform_prefetcher(
     ring under the same ``buffer.device_cache`` policy as the sequential
     path (incl. the dp-sharded variant on multi-device meshes), else host
     sampling staged one burst ahead ([G, B, ...] batches)."""
+    if host_sample_fn is None:
+        def host_sample_fn(g):  # noqa: F811 — default uniform host sample
+            s = rb.sample(batch_size * g, sample_next_obs=sample_next_obs, n_samples=1)
+            return {
+                k: np.asarray(v).reshape(g, batch_size, *np.asarray(v).shape[2:])
+                for k, v in s.items()
+            }
     if _use_ring(cfg, dist, row_bytes_hint, rb.buffer_size * rb.n_envs, multi_ok=True):
         if dist.world_size == 1:
             return DeviceUniformRingPrefetcher(
@@ -757,12 +810,7 @@ def make_uniform_prefetcher(
             ),
         )
         if sharded is not None:
-            return sharded
-    if host_sample_fn is None:
-        def host_sample_fn(g):  # noqa: F811 — default uniform host sample
-            s = rb.sample(batch_size * g, sample_next_obs=sample_next_obs, n_samples=1)
-            return {
-                k: np.asarray(v).reshape(g, batch_size, *np.asarray(v).shape[2:])
-                for k, v in s.items()
-            }
+            # warmup hole: a device block with no ready sub-buffer serves
+            # host-staged batches instead of raising (satellite ADVICE r5)
+            return sharded.attach_fallback(host_sample_fn)
     return StagedPrefetcher(host_sample_fn, dist.sharding(None, "dp"))

@@ -296,6 +296,136 @@ def detect_preemption(tl: Timeline, cfg: Any = None) -> List[Finding]:
     ]
 
 
+def detect_worker_flap(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Fleet workers dying and respawning repeatedly: each respawn costs a
+    full process spawn (interpreter + jax import + env construction) and a
+    round-merge stall — a flapping worker quietly taxes every round even
+    when the run 'succeeds'."""
+    min_faults = int(_sel(cfg, "diag.fleet.min_faults", 2))
+    faults = [
+        rec for rec in tl.of("fleet") if rec.get("action") in ("crash", "hang", "torn_packet")
+    ]
+    if len(faults) < min_faults:
+        return []
+    per_worker: Dict[Any, int] = {}
+    for rec in faults:
+        per_worker[rec.get("worker")] = per_worker.get(rec.get("worker"), 0) + 1
+    worst_worker, worst = max(per_worker.items(), key=lambda kv: kv[1])
+    kinds = {rec.get("action") for rec in faults}
+    chaos = bool(tl.of("chaos"))
+    chaos_note = " (a chaos schedule was active — injected faults look identical by design)" if chaos else ""
+    return [
+        Finding(
+            code="worker_flap",
+            severity="warning",
+            title=(
+                f"fleet worker flap: {len(faults)} fault(s) across "
+                f"{len(per_worker)} worker(s) ({', '.join(sorted(kinds))})"
+            ),
+            detail=(
+                f"Worst offender: worker {worst_worker} with {worst} fault(s). Each fault "
+                f"costs a respawn (process + backend startup) and delays its rounds."
+                + chaos_note
+            ),
+            remediation=(
+                "Check the worker's stderr for the crash traceback (the learner log "
+                "carries `[fleet] worker N fault: ...` lines). A flaky env suite wants "
+                "`env.restart_on_exception=True` inside the worker; raise "
+                "`fleet.hang_s` if slow env resets are being mistaken for hangs; "
+                "`fleet.max_fails`/`fleet.fail_window_s` tune when flap becomes "
+                "quarantine."
+            ),
+            step_first=min(int(rec.get("step") or 0) for rec in faults),
+            step_last=max(int(rec.get("step") or 0) for rec in faults),
+            data={"faults": len(faults), "per_worker": {str(k): v for k, v in per_worker.items()}},
+        )
+    ]
+
+
+def detect_fleet_degraded(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Intervals where fewer workers were alive than configured: the run kept
+    going (that is the point of the supervision tree) but collected env
+    steps slower than provisioned."""
+    min_intervals = int(_sel(cfg, "diag.fleet.degraded_min_intervals", 1))
+    evs = list(tl.of("fleet"))
+    # the post-drain snapshot always reads alive=0 (every worker was just
+    # stopped) — shutdown is not degradation, so only intervals BEFORE the
+    # drain count. Conversely the engine force-emits an interval the moment
+    # a fault lands, so degraded intervals are a precise signal: a healthy
+    # run records none at all.
+    drain_at = next(
+        (i for i, rec in enumerate(evs) if rec.get("action") == "drain"), len(evs)
+    )
+    intervals = [rec for rec in evs[:drain_at] if rec.get("action") == "interval"]
+    degraded = [
+        rec
+        for rec in intervals
+        if (rec.get("workers") or 0) > 0 and (rec.get("alive") or 0) < rec.get("workers")
+    ]
+    if len(degraded) < min_intervals:
+        return []
+    worst = min(int(rec.get("alive") or 0) for rec in degraded)
+    workers = int(degraded[0].get("workers") or 0)
+    return [
+        Finding(
+            code="fleet_degraded",
+            severity="warning",
+            title=(
+                f"fleet ran degraded for {len(degraded)}/{len(intervals)} interval(s) "
+                f"(low-water {worst}/{workers} workers alive)"
+            ),
+            detail=(
+                f"Alive-worker count dropped below the configured {workers} in "
+                f"{len(degraded)} telemetry interval(s); env-step throughput scales "
+                "with the alive count, so those intervals collected proportionally "
+                "fewer steps."
+            ),
+            remediation=(
+                "Correlate with the crash/hang/respawn incidents in the same step "
+                "range (worker_flap finding). If degradation is chronic rather than "
+                "a blip, shrink `fleet.backoff_s` (faster respawn) or fix the "
+                "underlying env instability."
+            ),
+            step_first=int(degraded[0].get("step") or 0),
+            step_last=int(degraded[-1].get("step") or 0),
+            data={"degraded_intervals": len(degraded), "intervals": len(intervals), "low_water": worst},
+        )
+    ]
+
+
+def detect_quarantine(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """A quarantined worker is a permanent capacity loss AND a data-shape
+    change (its env slice stopped contributing) — always worth a human
+    look, hence critical."""
+    events = [rec for rec in tl.of("fleet") if rec.get("action") == "quarantine"]
+    if not events:
+        return []
+    workers = sorted({rec.get("worker") for rec in events})
+    return [
+        Finding(
+            code="quarantine",
+            severity="critical",
+            title=f"{len(workers)} fleet worker(s) QUARANTINED: {workers}",
+            detail=(
+                f"Worker(s) {workers} exhausted the fail budget "
+                f"({events[0].get('detail', '')}) and were permanently excluded. The "
+                "run continued degraded on the surviving slice (fixed-width replay "
+                "layouts backfill the missing columns by duplicating survivors; "
+                "per-env layouts stop growing those columns)."
+            ),
+            remediation=(
+                "The env slice is likely poisoned (bad seed, corrupt asset, leaking "
+                "external process). Reproduce with the worker's column seeds, or "
+                "raise `fleet.max_fails` if the faults were transient infra. Resume "
+                "restores the full fleet: `sheeprl_tpu resume run_dir=...`."
+            ),
+            step_first=min(int(rec.get("step") or 0) for rec in events),
+            step_last=max(int(rec.get("step") or 0) for rec in events),
+            data={"workers": [int(w) for w in workers if w is not None]},
+        )
+    ]
+
+
 def detect_incomplete_stream(tl: Timeline, cfg: Any = None) -> List[Finding]:
     """No shutdown event: the process died without closing telemetry — a
     crash, OOM-kill or external SIGKILL (a clean preemption still writes
@@ -333,6 +463,9 @@ DETECTORS: List[Callable[[Timeline, Any], List[Finding]]] = [
     detect_throughput_degradation,
     detect_watchdog_incidents,
     detect_preemption,
+    detect_worker_flap,
+    detect_fleet_degraded,
+    detect_quarantine,
     detect_incomplete_stream,
 ]
 

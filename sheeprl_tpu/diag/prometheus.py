@@ -286,6 +286,33 @@ class Registry:
                 self.histogram(
                     "ckpt_write_ms", "background durable-write time (ms)", LATENCY_MS_BUCKETS
                 ).observe(float(rec["write_ms"]))
+        elif event == "fleet":
+            action = rec.get("action")
+            if action == "interval":
+                self.gauge("fleet_workers", "configured fleet size").set(float(rec.get("workers") or 0))
+                self.gauge("fleet_alive_workers", "workers currently running").set(
+                    float(rec.get("alive") or 0)
+                )
+                self.gauge("fleet_quarantined_workers", "workers quarantined").set(
+                    float(rec.get("quarantined") or 0)
+                )
+                self.gauge("fleet_respawns", "cumulative worker respawns").set(
+                    float(rec.get("respawns") or 0)
+                )
+                self.gauge("fleet_queue_depth_max", "worker→learner queue high-water").set(
+                    float(rec.get("queue_depth_max") or 0)
+                )
+                self.gauge("fleet_dropped_steps", "env steps that never landed").set(
+                    float(rec.get("dropped_steps") or 0)
+                )
+            elif action in (
+                "crash", "hang", "torn_packet", "stale_packet", "quarantine", "respawn", "spawn"
+            ):
+                self.counter(f"fleet_{action}_total", f"fleet worker {action} incidents").inc()
+        elif event == "chaos":
+            self.counter(
+                f"chaos_{rec.get('fault', 'fault')}_total", "injected chaos faults"
+            ).inc()
         elif event == "retry":
             self.counter("retries_total", "transient-op retries").inc()
         elif event == "watchdog":

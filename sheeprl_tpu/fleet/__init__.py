@@ -1,0 +1,30 @@
+"""Fault-tolerant actor fleet: supervised multi-process env workers.
+
+The overlap engine (`sheeprl_tpu/engine/`) moved env stepping onto a thread;
+this package moves it onto *processes* — N supervised workers each stepping
+a slice of the vector env and streaming transition packets to the learner
+over bounded queues, with param snapshots flowing the other way (the
+Podracer / parameter-server actor layout, built as a supervision tree from
+day one: crash→respawn, hang→heartbeat escalation, repeated-crasher
+quarantine, SIGTERM drain).
+
+Enable per-run with ``algo.fleet.workers=N`` (sac / dreamer_v3 / ppo);
+tune the supervision knobs under the root ``fleet`` config group and
+inject deterministic faults with ``resilience.chaos.*``
+(`sheeprl_tpu/resilience/chaos.py`). See ``howto/fleet.md``.
+"""
+from .engine import FleetEngine, FleetRound
+from .protocol import FleetPacket, TornPacketError, WorkerChannel, decode_packet, encode_packet
+from .supervisor import FleetSupervisor, WorkerHandle
+
+__all__ = [
+    "FleetEngine",
+    "FleetPacket",
+    "FleetRound",
+    "FleetSupervisor",
+    "TornPacketError",
+    "WorkerChannel",
+    "WorkerHandle",
+    "decode_packet",
+    "encode_packet",
+]

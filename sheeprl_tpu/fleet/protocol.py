@@ -1,0 +1,145 @@
+"""Wire protocol between the learner process and its env workers.
+
+One :class:`WorkerChannel` per worker:
+
+* ``data`` — a bounded ``mp.Queue`` carrying framed transition packets
+  worker→learner. The bound IS the backpressure: a worker that runs ahead
+  of the learner parks on ``put`` (stamping its heartbeat while it waits,
+  so backpressure never looks like a hang).
+* ``ctrl`` — an unbounded ``mp.Queue`` learner→worker for param
+  publications and the stop message. Publications are versioned and the
+  worker always drains to the NEWEST one (skipping versions is the whole
+  point of a parameter-server actor: stale-but-bounded params, no sync).
+* ``heartbeat`` — a shared ``mp.Value`` counter the worker bumps every
+  loop, even while blocked on a full data queue. The supervisor feeds it
+  into a per-worker :class:`~sheeprl_tpu.resilience.supervisor.HeartbeatWatchdog`.
+* ``param_version`` — a shared ``mp.Value`` the worker stamps with each
+  publication it APPLIES. The learner's strict-round republish nudge
+  consults it so only a worker genuinely missing the newest publication
+  (a dropped/lost ctrl message) is re-sent the param blob — a healthy
+  worker mid-rollout is never spammed with redundant copies.
+* ``stop`` — a shared ``mp.Event``; set once at shutdown so a worker
+  blocked anywhere can notice without a ctrl-queue race.
+
+Packets are framed as ``(worker_id, incarnation, seq, crc32, payload_bytes)``
+with the CRC computed over the pickled payload. A frame whose CRC does not
+match (a torn packet — proved by the chaos layer's byte-flipper) is
+*rejected*, counted, and treated as a worker fault: transitions are never
+silently truncated into the replay buffer.
+"""
+from __future__ import annotations
+
+import pickle
+import zlib
+from typing import Any, List, NamedTuple, Optional, Tuple
+
+__all__ = [
+    "CTRL_PARAMS",
+    "CTRL_STOP",
+    "FleetPacket",
+    "TornPacketError",
+    "WorkerChannel",
+    "decode_packet",
+    "encode_packet",
+]
+
+CTRL_PARAMS = "params"
+CTRL_STOP = "stop"
+
+
+class FleetPacket(NamedTuple):
+    """One decoded transition packet: ``payload`` is whatever the worker's
+    program produced for one interaction slice (a ``RecordingSink`` for the
+    step-based algorithms, a rollout tuple for PPO)."""
+
+    worker_id: int
+    incarnation: int
+    seq: int
+    env_steps: int
+    version: int  # param publication version the worker acted with
+    payload: Any
+    stats: Tuple[Tuple[str, float], ...] = ()
+
+
+class TornPacketError(RuntimeError):
+    """A frame failed CRC/unpickle validation — corrupted in flight."""
+
+
+def encode_packet(pkt: FleetPacket) -> Tuple[int, int, int, int, int, int, bytes]:
+    """Frame a packet: the payload (+stats) is pickled once here; the scalar
+    header stays outside the blob so the learner can account a torn packet
+    to the right worker without trusting the corrupted bytes."""
+    blob = pickle.dumps((pkt.payload, pkt.stats), protocol=pickle.HIGHEST_PROTOCOL)
+    return (
+        int(pkt.worker_id),
+        int(pkt.incarnation),
+        int(pkt.seq),
+        int(pkt.env_steps),
+        int(pkt.version),
+        zlib.crc32(blob),
+        blob,
+    )
+
+
+def decode_packet(frame: Any) -> FleetPacket:
+    """Validate + decode one frame; raises :class:`TornPacketError` on any
+    corruption (bad CRC, unpicklable payload, malformed frame)."""
+    try:
+        worker_id, incarnation, seq, env_steps, version, crc, blob = frame
+    except (TypeError, ValueError) as err:
+        raise TornPacketError(f"malformed frame: {err}") from err
+    if zlib.crc32(blob) != crc:
+        raise TornPacketError(
+            f"worker {worker_id} packet seq={seq}: CRC mismatch ({len(blob)} bytes)"
+        )
+    try:
+        payload, stats = pickle.loads(blob)
+    except Exception as err:  # corrupted in a way the CRC happened to pass
+        raise TornPacketError(f"worker {worker_id} packet seq={seq}: {err!r}") from err
+    return FleetPacket(
+        int(worker_id), int(incarnation), int(seq), int(env_steps), int(version), payload, stats
+    )
+
+
+class WorkerChannel:
+    """The per-worker queue pair + shared liveness state. Built by the
+    supervisor with a ``spawn`` multiprocessing context; a fresh channel is
+    created for every incarnation so a corrupted queue never outlives the
+    process that corrupted it."""
+
+    def __init__(self, ctx: Any, queue_depth: int = 4):
+        self.data = ctx.Queue(maxsize=max(1, int(queue_depth)))
+        self.ctrl = ctx.Queue()
+        self.heartbeat = ctx.Value("q", 0, lock=False)
+        self.param_version = ctx.Value("q", 0, lock=False)
+        self.stop = ctx.Event()
+
+    # -- learner side ------------------------------------------------------
+    def drain_data(self, limit: int = 1024) -> List[Any]:
+        """Non-blocking sweep of everything currently queued. mp.Queue.get
+        unpickles in THIS process, so a worker killed mid-``put`` can leave a
+        truncated stream that raises (UnpicklingError et al.) — any failure
+        here just ends the sweep: the frames already read survive, the
+        channel is about to be torn down by the fault path anyway, and the
+        learner must never die from its dead worker's garbage."""
+        import queue as _q
+
+        out: List[Any] = []
+        for _ in range(limit):
+            try:
+                out.append(self.data.get_nowait())
+            except _q.Empty:
+                break
+            except Exception:
+                break
+        return out
+
+    def close(self) -> None:
+        for q in (self.data, self.ctrl):
+            try:
+                q.close()
+                # do NOT join_thread(): a feeder mid-pickle on a dead queue
+                # must not hang shutdown; cancel lets the process exit drop it
+                q.cancel_join_thread()
+            except Exception:
+                pass

@@ -1,0 +1,451 @@
+"""The fleet supervision tree: spawn, watch, respawn, quarantine.
+
+Going multi-process makes worker death a *normal* event, so the supervisor
+treats every failure as data, not as an exception:
+
+* **crash** — the process exitcode flips non-None while the fleet is
+  running. Whatever the dead incarnation left in its data queue is salvaged
+  first (those packets were produced and framed before death — the CRC
+  decides, not the death), then the worker respawns with jittered
+  exponential backoff (the `with_retries` schedule, applied to a process
+  instead of a call).
+* **hang** — the shared heartbeat counter stops advancing. Each worker has
+  its own :class:`~sheeprl_tpu.resilience.supervisor.HeartbeatWatchdog`
+  watching that counter; when it fires the supervisor re-checks the counter
+  (a watchdog firing during a long learner burst is a false alarm if the
+  counter moved) and, if genuinely wedged, SIGKILLs the process and routes
+  it through the same fault path as a crash.
+* **torn packet** — a frame failed CRC validation learner-side. Corrupted
+  IPC means the incarnation can't be trusted: same fault path.
+* **fail budget → quarantine** — more than ``max_fails`` faults inside
+  ``fail_window_s`` flags the worker's env slice as poisoned: the worker is
+  never respawned, its columns are excluded from new rounds, and the fleet
+  degrades gracefully (the engine shrinks the round width and keeps the
+  replay-ratio ledger exact over the *surviving* steps).
+
+Every transition emits a ``fleet`` JSONL telemetry event, so `doctor` can
+reconstruct the incident timeline (`worker_flap` / `fleet_degraded` /
+`quarantine` findings).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import pickle
+import random
+import sys
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..resilience.chaos import chaos_from_cfg
+from ..resilience.supervisor import HeartbeatWatchdog
+from .protocol import CTRL_PARAMS, CTRL_STOP, WorkerChannel
+from .worker import worker_entry
+
+__all__ = ["FleetSupervisor", "WorkerHandle"]
+
+
+def _emit(telem: Any, rec: Dict[str, Any]) -> None:
+    if telem is not None:
+        try:
+            telem.emit(rec)
+        except Exception:
+            pass
+
+
+class WorkerHandle:
+    """Supervision state for one worker slot (stable across incarnations)."""
+
+    def __init__(self, worker_id: int):
+        self.worker_id = int(worker_id)
+        self.proc: Optional[mp.process.BaseProcess] = None
+        self.channel: Optional[WorkerChannel] = None
+        self.chaos: Optional[Any] = None
+        self.watchdog: Optional[HeartbeatWatchdog] = None
+        self.incarnation = 0
+        self.state = "new"  # new | running | backoff | quarantined | stopped
+        self.spawned_at = 0.0
+        self.fails: deque = deque()  # (monotonic_t, reason)
+        self.respawn_at = 0.0
+        self.respawns = 0
+        self.salvage: List[Any] = []  # frames drained from a dead incarnation
+        self.hung_stall: Optional[tuple] = None  # (hb_at_stall, stalled_s)
+
+    @property
+    def active(self) -> bool:
+        """Counts toward round membership: alive now or coming back."""
+        return self.state in ("running", "backoff")
+
+    @property
+    def alive(self) -> bool:
+        return self.state == "running" and self.proc is not None and self.proc.is_alive()
+
+
+class FleetSupervisor:
+    def __init__(
+        self,
+        cfg: Any,
+        telem: Any = None,
+        *,
+        program: str,
+        num_workers: int,
+        queue_depth: int = 4,
+        hang_s: float = 60.0,
+        spawn_grace_s: float = 120.0,
+        backoff_s: float = 0.5,
+        max_backoff_s: float = 30.0,
+        jitter: float = 0.5,
+        max_fails: int = 3,
+        fail_window_s: float = 300.0,
+        worker_platform: str = "cpu",
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.telem = telem
+        self.program = str(program)
+        self.num_workers = int(num_workers)
+        self.queue_depth = int(queue_depth)
+        self.hang_s = float(hang_s)
+        self.spawn_grace_s = float(spawn_grace_s)
+        self.backoff_s = float(backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.jitter = float(jitter)
+        self.max_fails = int(max_fails)
+        self.fail_window_s = float(fail_window_s)
+        self.worker_platform = str(worker_platform)
+        self.seed = int(seed)
+        self._ctx = mp.get_context("spawn")
+        self._cfg_dict = cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg)
+        self.handles: List[WorkerHandle] = [WorkerHandle(i) for i in range(self.num_workers)]
+        self._last_params: Optional[tuple] = None  # (version, payload)
+        # global env-step progress (engine-maintained): spawns seed the
+        # program's lifetime counter from it so learning_starts gating
+        # survives respawn and checkpoint resume instead of resetting to
+        # random-action warmup mid-run
+        self.progress_step = 0
+        self.pub_seq = 0
+        self.total_respawns = 0
+        self.torn_packets = 0
+        self.crashes = 0
+        self.hangs = 0
+        self._stopping = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "FleetSupervisor":
+        for handle in self.handles:
+            self._spawn(handle)
+        return self
+
+    def _spawn(self, handle: WorkerHandle) -> None:
+        handle.channel = WorkerChannel(self._ctx, self.queue_depth)
+        handle.chaos = chaos_from_cfg(self.cfg, handle.worker_id, run_seed=self.seed)
+        if handle.chaos is not None:
+            handle.chaos.incarnation = handle.incarnation
+        spec = {
+            "program": self.program,
+            "cfg": self._cfg_dict,
+            "worker_id": handle.worker_id,
+            "num_workers": self.num_workers,
+            "incarnation": handle.incarnation,
+            "initial_lifetime": self.progress_step // self.num_workers,
+        }
+        # the child inherits os.environ at exec: pin its backend BEFORE the
+        # interpreter starts so `import jax` in the child never touches the
+        # learner's accelerator (restored immediately — spawn's exec happens
+        # inside start())
+        saved = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = self.worker_platform
+        try:
+            handle.proc = self._ctx.Process(
+                target=worker_entry,
+                args=(spec, handle.channel, handle.chaos),
+                name=f"fleet-worker-{handle.worker_id}",
+                daemon=True,
+            )
+            handle.proc.start()
+        finally:
+            if saved is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = saved
+        handle.state = "running"
+        handle.hung_stall = None
+        handle.spawned_at = time.monotonic()
+        if handle.watchdog is None:
+            handle.watchdog = HeartbeatWatchdog(
+                stall_s=self.hang_s,
+                action="none",
+                telem=None,  # the supervisor emits the fleet-scoped event
+                poll_s=max(0.05, min(1.0, self.hang_s / 5.0)),
+                on_stall=self._make_on_stall(handle),
+            ).start()
+        handle.watchdog.beat(-1 - handle.incarnation)  # fresh epoch per spawn
+        _emit(
+            self.telem,
+            {
+                "event": "fleet",
+                "action": "respawn" if handle.incarnation else "spawn",
+                "step": 0,
+                "worker": handle.worker_id,
+                "incarnation": handle.incarnation,
+                "pid": handle.proc.pid,
+            },
+        )
+        # a respawned worker starts acting with the newest snapshot at once
+        if self._last_params is not None:
+            try:
+                handle.channel.ctrl.put((CTRL_PARAMS, self._last_params[0], self._last_params[1]))
+            except Exception:
+                pass
+
+    def _make_on_stall(self, handle: WorkerHandle) -> Callable[[int, float], None]:
+        def on_stall(hb_at_stall: int, stalled_s: float) -> None:
+            handle.hung_stall = (hb_at_stall, stalled_s)
+
+        return on_stall
+
+    # -- param publication -------------------------------------------------
+    def publish(self, params_np: Any) -> int:
+        """Push a versioned param snapshot to every live worker (the fleet
+        half of the ParamMirror→publication path). Returns the version.
+
+        The snapshot is pickled ONCE here and the same bytes blob is put on
+        every ctrl queue — N queue feeders re-pickling a multi-MB pytree
+        independently would tax the learner host N× per train burst; a
+        bytes put is a memcpy. Workers unpickle on receipt."""
+        self.pub_seq += 1
+        blob = pickle.dumps(params_np, protocol=pickle.HIGHEST_PROTOCOL)
+        self._last_params = (self.pub_seq, blob)
+        for handle in self.handles:
+            if handle.state != "running" or handle.channel is None:
+                continue
+            if handle.chaos is not None and handle.chaos.drops_publication(self.pub_seq):
+                _emit(
+                    self.telem,
+                    {
+                        "event": "chaos",
+                        "fault": "dropped_publication",
+                        "worker": handle.worker_id,
+                        "seq": self.pub_seq,
+                    },
+                )
+                continue
+            try:
+                handle.channel.ctrl.put((CTRL_PARAMS, self.pub_seq, blob))
+            except Exception:
+                pass  # a dying worker's queue: the monitor will catch it
+        return self.pub_seq
+
+    def resend_params(self, worker_id: int, step: int = 0) -> None:
+        """Re-deliver the newest publication to one running worker — the
+        recovery path for a lost/dropped ctrl message (e.g. chaos
+        ``drop_publication``). Idempotent worker-side (same version, same
+        bytes: a worker already past it just re-parks), but it unblocks a
+        strict-mode worker parked forever on a publication that never
+        arrived. Deliberately does NOT consult the chaos injector: the drop
+        already happened, this is the recovery."""
+        handle = self.handles[worker_id]
+        if handle.state != "running" or handle.channel is None or self._last_params is None:
+            return
+        _emit(
+            self.telem,
+            {
+                "event": "fleet",
+                "action": "republish",
+                "step": int(step),
+                "worker": handle.worker_id,
+                "detail": f"publication {self._last_params[0]} re-delivered",
+            },
+        )
+        try:
+            handle.channel.ctrl.put((CTRL_PARAMS, self._last_params[0], self._last_params[1]))
+        except Exception:
+            pass
+
+    # -- monitoring --------------------------------------------------------
+    def monitor(self, step: int = 0) -> None:
+        """One supervision sweep (called from the learner's round wait):
+        detect crashes/hangs, run due respawns, apply the fail budget."""
+        now = time.monotonic()
+        for handle in self.handles:
+            if handle.state == "running":
+                proc = handle.proc
+                if proc is not None and proc.exitcode is not None and not self._stopping:
+                    self.crashes += 1
+                    self.fault(
+                        handle, "crash", step=step, detail=f"exitcode={proc.exitcode}",
+                        exitcode=int(proc.exitcode),
+                    )
+                    continue
+                if handle.channel is not None and handle.watchdog is not None:
+                    hb = int(handle.channel.heartbeat.value)
+                    if hb <= 0:
+                        # still starting up (interpreter + jax import + env
+                        # construction): the hang clock starts at the FIRST
+                        # heartbeat; a worker wedged in startup is caught by
+                        # the (much longer) spawn grace budget instead
+                        handle.hung_stall = None
+                        if now - handle.spawned_at > self.spawn_grace_s:
+                            self.hangs += 1
+                            self.fault(
+                                handle,
+                                "hang",
+                                step=step,
+                                detail=(
+                                    f"no heartbeat within {self.spawn_grace_s:.0f}s of spawn"
+                                ),
+                            )
+                        continue
+                    handle.watchdog.beat(hb)
+                    if handle.hung_stall is not None:
+                        hb_at_stall, stalled_s = handle.hung_stall
+                        if hb != hb_at_stall:
+                            handle.hung_stall = None  # advanced: false alarm
+                        else:
+                            self.hangs += 1
+                            self.fault(
+                                handle,
+                                "hang",
+                                step=step,
+                                detail=f"no heartbeat for {stalled_s:.1f}s",
+                            )
+            elif handle.state == "backoff" and now >= handle.respawn_at:
+                handle.incarnation += 1
+                handle.respawns += 1
+                self.total_respawns += 1
+                self._spawn(handle)
+
+    def fault(
+        self,
+        handle: WorkerHandle,
+        reason: str,
+        step: int = 0,
+        detail: str = "",
+        exitcode: Optional[int] = None,
+    ) -> None:
+        """Route one worker failure: salvage its queue, kill what's left,
+        then either schedule a respawn or quarantine the slice."""
+        if handle.state in ("quarantined", "stopped"):
+            return
+        # salvage packets the dead incarnation already framed: they were
+        # produced before the fault and carry their own CRC
+        if handle.channel is not None:
+            handle.salvage.extend(handle.channel.drain_data())
+        proc, handle.proc = handle.proc, None
+        if proc is not None and proc.is_alive():
+            proc.kill()
+            proc.join(timeout=5.0)
+        if handle.channel is not None:
+            handle.channel.close()
+            handle.channel = None
+        handle.hung_stall = None
+        now = time.monotonic()
+        handle.fails.append((now, reason))
+        while handle.fails and now - handle.fails[0][0] > self.fail_window_s:
+            handle.fails.popleft()
+        rec = {
+            "event": "fleet",
+            "action": reason,
+            "step": int(step),
+            "worker": handle.worker_id,
+            "incarnation": handle.incarnation,
+            "fails_in_window": len(handle.fails),
+            "detail": str(detail),
+        }
+        if exitcode is not None:
+            rec["exitcode"] = exitcode
+        _emit(self.telem, rec)
+        print(
+            f"[fleet] worker {handle.worker_id} fault: {reason} ({detail}); "
+            f"{len(handle.fails)}/{self.max_fails} in window",
+            file=sys.stderr,
+            flush=True,
+        )
+        if len(handle.fails) > self.max_fails:
+            handle.state = "quarantined"
+            _emit(
+                self.telem,
+                {
+                    "event": "fleet",
+                    "action": "quarantine",
+                    "step": int(step),
+                    "worker": handle.worker_id,
+                    "fails_in_window": len(handle.fails),
+                    "detail": f"fail budget exhausted ({self.max_fails} in {self.fail_window_s:.0f}s)",
+                },
+            )
+            print(
+                f"[fleet] worker {handle.worker_id} QUARANTINED "
+                f"(its env slice is excluded; the fleet degrades gracefully)",
+                file=sys.stderr,
+                flush=True,
+            )
+        else:
+            # with_retries schedule, applied to a process respawn
+            n = len(handle.fails)
+            delay = min(self.max_backoff_s, self.backoff_s * (2 ** (n - 1)))
+            delay *= max(0.0, 1.0 + random.uniform(-self.jitter, self.jitter))
+            handle.state = "backoff"
+            handle.respawn_at = now + delay
+
+    # -- views -------------------------------------------------------------
+    def active_ids(self) -> List[int]:
+        return [h.worker_id for h in self.handles if h.active]
+
+    def alive_count(self) -> int:
+        return sum(1 for h in self.handles if h.alive)
+
+    def quarantined_ids(self) -> List[int]:
+        return [h.worker_id for h in self.handles if h.state == "quarantined"]
+
+    def queue_depth_max(self) -> int:
+        out = 0
+        for h in self.handles:
+            if h.channel is not None:
+                try:
+                    out = max(out, h.channel.data.qsize())
+                except (NotImplementedError, OSError):
+                    pass
+        return out
+
+    # -- shutdown ----------------------------------------------------------
+    def shutdown(self, timeout: float = 10.0) -> Dict[int, List[Any]]:
+        """Stop every worker and return the leftover raw frames per worker
+        (salvage + whatever was still queued) for the engine to drain."""
+        self._stopping = True
+        for handle in self.handles:
+            if handle.channel is not None:
+                handle.channel.stop.set()
+                try:
+                    handle.channel.ctrl.put((CTRL_STOP,))
+                except Exception:
+                    pass
+        leftovers: Dict[int, List[Any]] = {}
+        deadline = time.monotonic() + timeout
+        for handle in self.handles:
+            frames = list(handle.salvage)
+            handle.salvage = []
+            proc = handle.proc
+            if proc is not None:
+                # drain WHILE joining: a worker parked on a full data queue
+                # can only exit once the queue has room
+                while proc.is_alive() and time.monotonic() < deadline:
+                    if handle.channel is not None:
+                        frames.extend(handle.channel.drain_data())
+                    proc.join(timeout=0.05)
+                if proc.is_alive():
+                    proc.kill()
+                    proc.join(timeout=5.0)
+            if handle.channel is not None:
+                frames.extend(handle.channel.drain_data())
+                handle.channel.close()
+                handle.channel = None
+            handle.proc = None
+            if handle.watchdog is not None:
+                handle.watchdog.stop()
+                handle.watchdog = None
+            if handle.state != "quarantined":
+                handle.state = "stopped"
+            leftovers[handle.worker_id] = frames
+        return leftovers

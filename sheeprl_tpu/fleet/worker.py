@@ -1,0 +1,180 @@
+"""The env-worker process: step a slice of the vector env, stream packets.
+
+Each worker is a real OS process (``spawn`` context — never ``fork``: the
+parent holds live XLA/threading state that a forked child would inherit in
+a corrupt half-copied form). The parent exports ``JAX_PLATFORMS=cpu`` into
+the child's environment before the interpreter starts, so workers act on
+the host CPU backend and never contend for (or wedge) the learner's
+accelerator — the Podracer parameter-server actor layout.
+
+A worker owns:
+
+* its **env slice**: ``num_envs / num_workers`` envs, seeded exactly like
+  the same columns of the serial loop's vector env;
+* its **program**: the per-algorithm acting logic
+  (:mod:`sheeprl_tpu.fleet.programs`), resolved by import path in the
+  child so the spawn args stay picklable;
+* the newest **param snapshot** pushed by the learner over the ctrl queue
+  (versions may be skipped — the worker always drains to the latest);
+* an optional :class:`~sheeprl_tpu.resilience.chaos.ChaosInjector`.
+
+The loop is intentionally boring: drain ctrl → maybe inject chaos → run one
+interaction slice into a ``RecordingSink`` → frame + CRC → put (stamping
+the heartbeat while blocked, so learner backpressure is never mistaken for
+a hang). All replay-buffer mutation happens learner-side when the packet is
+applied — the worker never touches shared state.
+"""
+from __future__ import annotations
+
+import importlib
+import os
+import pickle
+import queue as _q
+import sys
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+from .protocol import CTRL_PARAMS, CTRL_STOP, FleetPacket, WorkerChannel, encode_packet
+
+__all__ = ["fleet_worker_loop", "worker_entry"]
+
+_PUT_POLL_S = 0.1  # heartbeat cadence while parked on a full data queue
+_IDLE_POLL_S = 0.005  # param-sync wait granularity (PPO strict mode)
+
+
+def _resolve_program(path: str):
+    module_name, _, fn_name = path.partition(":")
+    if not fn_name:
+        raise ValueError(f"fleet program must be 'module:function', got {path!r}")
+    return getattr(importlib.import_module(module_name), fn_name)
+
+
+def fleet_worker_loop(
+    program: Any,
+    channel: WorkerChannel,
+    chaos: Optional[Any],
+    worker_id: int,
+    incarnation: int,
+) -> None:
+    """The worker hot loop (scanned by ``scripts/check_host_sync.py`` — keep
+    it free of hidden device syncs; the program's jitted act is the only
+    device interaction and its outputs are consumed as numpy by the env)."""
+    from ..engine import RecordingSink
+
+    heartbeat = 0
+    seq = 0
+    lifetime_steps = 0
+    version = 0  # newest publication applied
+    used_version = 0  # publication the LAST slice acted with (sync mode)
+    sync_mode = bool(getattr(program, "sync_params", False))
+
+    def _beat() -> None:
+        # liveness pulse: programs with long slices (a PPO rollout is
+        # rollout_steps env steps in ONE program.step call) stamp this
+        # between env steps so a legitimately slow slice is never
+        # misdiagnosed as a hang and SIGKILLed at fleet.hang_s
+        nonlocal heartbeat
+        heartbeat += 1
+        channel.heartbeat.value = heartbeat
+
+    program.beat = _beat
+    while not channel.stop.is_set():
+        # ---- control: drain to the newest publication --------------------
+        latest: Optional[tuple] = None
+        while True:
+            try:
+                msg = channel.ctrl.get_nowait()
+            except (_q.Empty, OSError, EOFError):
+                break
+            if msg[0] == CTRL_STOP:
+                return
+            if msg[0] == CTRL_PARAMS:
+                latest = msg
+        if latest is not None:
+            # publications arrive as a shared pickle blob (dumped once
+            # learner-side for the whole fleet); only the newest is decoded
+            program.set_params(pickle.loads(latest[2]), int(latest[1]))
+            version = int(latest[1])
+            channel.param_version.value = version
+        if sync_mode and version <= used_version:
+            # strict on-policy mode: one slice per publication — park until
+            # the learner publishes the next params (or stops)
+            heartbeat += 1
+            channel.heartbeat.value = heartbeat
+            time.sleep(_IDLE_POLL_S)
+            continue
+
+        # ---- chaos: may crash / hang / slow this slice --------------------
+        if chaos is not None:
+            chaos.on_step(lifetime_steps)
+
+        # ---- one interaction slice ---------------------------------------
+        _beat()  # the slice gets the full fleet.hang_s budget from HERE
+        sink = RecordingSink()
+        env_steps, payload = program.step(sink)
+        if payload is None:
+            payload = sink
+        used_version = version
+        pkt = FleetPacket(worker_id, incarnation, seq, int(env_steps), version, payload)
+        frame = encode_packet(pkt)
+        if chaos is not None:
+            frame = frame[:-1] + (chaos.corrupt(frame[-1], seq),)
+
+        # ---- handoff (bounded queue = backpressure) -----------------------
+        while not channel.stop.is_set():
+            heartbeat += 1
+            channel.heartbeat.value = heartbeat
+            try:
+                channel.data.put(frame, timeout=_PUT_POLL_S)
+                break
+            except _q.Full:
+                continue
+        seq += 1
+        lifetime_steps += int(env_steps)
+        heartbeat += 1
+        channel.heartbeat.value = heartbeat
+
+
+def worker_entry(spec: Dict[str, Any], channel: WorkerChannel, chaos: Optional[Any]) -> None:
+    """Process entrypoint (spawn target). ``spec`` is a plain dict:
+    ``{program, cfg, worker_id, num_workers, incarnation}``."""
+    worker_id = int(spec["worker_id"])
+    incarnation = int(spec["incarnation"])
+    try:
+        # tame the child's footprint before jax initializes: workers are
+        # numpy/env-bound, a thread pool per worker just thrashes the host
+        os.environ.setdefault("XLA_FLAGS", "--xla_cpu_multi_thread_eigen=false")
+        from ..config import Config
+
+        cfg = Config(spec["cfg"])
+        program = _resolve_program(str(spec["program"]))(
+            cfg, worker_id, int(spec["num_workers"])
+        )
+        if hasattr(program, "lifetime"):
+            # respawn/resume: the learning_starts gate compares lifetime
+            # against global progress — starting from 0 would put a late
+            # (re)spawn back into random-action warmup
+            program.lifetime = int(spec.get("initial_lifetime", 0))
+        if chaos is not None:
+            chaos.incarnation = incarnation
+        fleet_worker_loop(program, channel, chaos, worker_id, incarnation)
+        rc = 0
+    except KeyboardInterrupt:
+        rc = 0
+    except BaseException:
+        print(
+            f"[fleet] worker {worker_id} (incarnation {incarnation}) died:\n"
+            + traceback.format_exc(),
+            file=sys.stderr,
+            flush=True,
+        )
+        rc = 1
+    finally:
+        try:
+            channel.close()
+        except Exception:
+            pass
+    # hard exit: skip atexit/teardown of the inherited mp plumbing — the
+    # parent owns the channels and a worker must never hang on its way out
+    os._exit(rc)

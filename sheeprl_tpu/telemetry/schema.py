@@ -173,6 +173,45 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "path": (False, _STR),
         "bytes": (False, _NUM),
     },
+    # actor-fleet supervision stream (sheeprl_tpu/fleet/): `action` is
+    # either a discrete incident (spawn | respawn | crash | hang | torn_packet
+    # | stale_packet | quarantine | drain) with per-worker fields, or "interval" — the
+    # periodic liveness snapshot (alive/quarantined counts, cumulative
+    # respawns/crashes/hangs/torn packets, queue-depth high-water,
+    # round-merge wait). `dropped_steps` counts env steps that never landed
+    # learner-side (incomplete trailing rounds at drain, discarded salvage).
+    "fleet": {
+        "action": (True, _STR),
+        "step": (True, _NUM),
+        "worker": (False, _NUM),
+        "incarnation": (False, _NUM),
+        "pid": (False, _NUM),
+        "exitcode": (False, _NUM),
+        "fails_in_window": (False, _NUM),
+        "detail": (False, _STR),
+        "workers": (False, _NUM),
+        "alive": (False, _NUM),
+        "quarantined": (False, _NUM),
+        "respawns": (False, _NUM),
+        "crashes": (False, _NUM),
+        "hangs": (False, _NUM),
+        "torn_packets": (False, _NUM),
+        "rounds": (False, _NUM),
+        "queue_depth_max": (False, _NUM),
+        "env_steps": (False, _NUM),
+        "dropped_steps": (False, _NUM),
+        "round_wait_s": (False, _NUM),
+        "interval_s": (False, _NUM),
+    },
+    # deterministic fault injection (resilience/chaos.py): faults the
+    # SUPERVISOR injects (worker-side faults surface as `fleet` incidents —
+    # a chaos crash is indistinguishable from a real one by design)
+    "chaos": {
+        "fault": (True, _STR),  # dropped_publication | armed
+        "worker": (False, _NUM),
+        "seq": (False, _NUM),
+        "detail": (False, _STR),
+    },
     # a run restored from a checkpoint (resilience/guard.py)
     "resume": {
         "step": (True, _NUM),

@@ -294,6 +294,19 @@ def get_dummy_env(id: str) -> gym.Env:
     raise ValueError(f"Unrecognized dummy environment: {id}")
 
 
+def probe_env_spaces(cfg: Config, seed: int, rank: int):
+    """Construct ONE fully-wrapped env just to read its (obs, action)
+    spaces, then close it. The fleet learner (`sheeprl_tpu/fleet/`) never
+    steps envs itself — the worker processes own them — but it still needs
+    the spaces to build the agent; this is the cheap way to get exactly the
+    spaces `vectorize(...).single_*_space` would report."""
+    env = make_env(cfg, seed, rank, None, vector_env_idx=0)()
+    try:
+        return env.observation_space, env.action_space
+    finally:
+        env.close()
+
+
 def vectorize(
     cfg: Config,
     seed: int,

@@ -136,11 +136,19 @@ class MlflowModelManager:
         assume_yes: bool = False,
     ) -> None:
         """Delete one version; interactive name confirmation like the
-        reference (mlflow.py:179-214) unless `assume_yes` or non-tty."""
+        reference (mlflow.py:179-214). Non-interactive callers must opt in
+        explicitly with `assume_yes=True` — a non-tty stdin must never turn
+        a confirmation prompt into a silent deletion."""
         stage = self._safe_get_stage(model_name, version)
         if stage is None:
             return
-        if not assume_yes and sys.stdin.isatty():
+        if not assume_yes:
+            if not sys.stdin.isatty():
+                raise RuntimeError(
+                    f"refusing to delete model `{model_name}` version {version}: stdin "
+                    "is not a terminal, so the name-confirmation prompt cannot run. "
+                    "Pass assume_yes=True to delete without confirmation."
+                )
             typed = input(
                 f"Model named `{model_name}`, version {version} is in stage {stage}, "
                 "type the model name to continue deletion:"
