@@ -28,7 +28,15 @@ Comparison rules (normalization — the trajectory is heterogeneous):
   leg, the fleet e2e leg `env steps/sec (fleet)`): every leg of the newest
   record gates on its OWN unit + platform class against the best comparable
   prior leg (searched across priors' headline AND extra legs), so a fleet
-  throughput slide is caught even though the headline unit never carried it.
+  throughput slide is caught even though the headline unit never carried it;
+* `SERVE_*.json` (scripts/bench_serve.py — the gateway load bench): gated
+  with the **direction flag the record carries** (`direction: lower` — the
+  headline value is p95 latency in ms, where UP is the regression), plus a
+  p99 gate and an ABSOLUTE shed-rate gate (newest shed_rate must not exceed
+  the best comparable prior by more than ``--shed-delta``; a ratio gate is
+  meaningless against a 0-shed baseline). Grouping is unit + platform class
+  as for BENCH — the unit string carries the session/replica scale, so a
+  1k-session smoke is never judged against a 10k-session run.
 
 ``--dry-run`` performs the full comparison and prints the report but always
 exits 0 unless the artifacts themselves are unreadable — that keeps the
@@ -47,8 +55,24 @@ from typing import Any, Dict, List, Optional, Tuple
 ROUND_RE = re.compile(r"_r(\d+)\.json$")
 CPU_CLASS = {"cpu", "cpu-fallback", "cpu-forced"}
 
-# the (field, pretty-name) pairs gated for regressions, most important first
-GATED_FIELDS = (("steady_state_sps", "steady-state SPS"), ("value", "headline SPS"), ("mfu", "MFU"))
+# the gated fields, most important first: (key, pretty-name, direction, mode).
+# direction "higher" = a drop is the regression (throughput), "lower" = a
+# rise is (latency, shed rate); a record's own `direction` field overrides
+# the spec for its headline `value`. mode "rel" gates on the fractional
+# change vs the best baseline, "abs" on the absolute delta (for rates whose
+# baseline is legitimately 0).
+GATED_FIELDS = (
+    ("steady_state_sps", "steady-state SPS", "higher", "rel"),
+    ("value", "headline SPS", "higher", "rel"),
+    ("mfu", "MFU", "higher", "rel"),
+)
+SERVE_GATED_FIELDS = (
+    ("value", "gateway p95 latency", "lower", "rel"),
+    ("p99_ms", "gateway p99 latency", "lower", "rel"),
+    ("shed_rate", "gateway shed rate", "lower", "abs"),
+)
+# absolute shed-rate increase vs the best comparable prior that fails the gate
+DEFAULT_SHED_DELTA = 0.05
 
 
 def _round_of(path: Path) -> int:
@@ -79,6 +103,28 @@ def load_trajectory(bench_dir: Any) -> List[Dict[str, Any]]:
         rec["_rc"] = wrapper.get("rc") if isinstance(wrapper, dict) else None
         # a failed round (timeout, crash) is excluded from baselines — it
         # documents an infra failure, not a performance level
+        rec["_usable"] = bool(parsed) and wrapper.get("rc") == 0 and rec.get("value") is not None
+        out.append(rec)
+    return out
+
+
+def load_serve_trajectory(bench_dir: Any) -> List[Dict[str, Any]]:
+    """All readable SERVE_*.json records (gateway load bench), oldest round
+    first — same wrapper format and bookkeeping as the BENCH trajectory.
+    A round whose wrapper carries ``rc != 0`` (schema-invalid record or
+    nonzero acked loss) is unusable, exactly like a crashed bench round."""
+    bench_dir = Path(bench_dir)
+    out: List[Dict[str, Any]] = []
+    for path in sorted(bench_dir.glob("SERVE_*.json"), key=_round_of):
+        try:
+            wrapper = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as err:
+            raise RuntimeError(f"unreadable serve-bench artifact {path}: {err}")
+        parsed = wrapper.get("parsed") if isinstance(wrapper, dict) else None
+        rec = dict(parsed) if isinstance(parsed, dict) else {}
+        rec["_round"] = _round_of(path)
+        rec["_file"] = path.name
+        rec["_rc"] = wrapper.get("rc") if isinstance(wrapper, dict) else None
         rec["_usable"] = bool(parsed) and wrapper.get("rc") == 0 and rec.get("value") is not None
         out.append(rec)
     return out
@@ -125,33 +171,54 @@ def _gate_fields(
     threshold: float,
     src_file: str,
     unit: Optional[str] = None,
+    fields: Tuple = GATED_FIELDS,
+    abs_delta: float = DEFAULT_SHED_DELTA,
 ) -> None:
-    """The GATED_FIELDS gate shared by the headline record and every extra
-    leg: compare ``rec`` against the best candidate per field; a drop of
-    >= threshold fails the report. ``unit`` tags the metric/failure labels
-    for extra legs (None = the headline gate)."""
+    """The field gate shared by the headline record, every extra leg and the
+    serve trajectory: compare ``rec`` against the best candidate per field
+    (best = max for higher-is-better, min for lower-is-better); a change for
+    the worse of >= threshold (fractional, or ``abs_delta`` for "abs"-mode
+    fields) fails the report. ``unit`` tags the metric/failure labels for
+    extra legs (None = the headline gate)."""
     tag = f" [{unit}]" if unit else ""
-    for key, label in GATED_FIELDS:
+    for key, label, direction, mode in fields:
+        if key == "value":
+            # per-unit direction flag: the artifact's own declaration wins
+            direction = rec.get("direction") or direction
+        lower = direction == "lower"
         new_val = rec.get(key)
-        baseline = max(
-            (float(c[key]) for c in candidates if c.get(key) is not None), default=None
-        )
+        vals = [float(c[key]) for c in candidates if c.get(key) is not None]
+        baseline = (min(vals) if lower else max(vals)) if vals else None
         cmp: Dict[str, Any] = {
             "metric": f"{key}{tag}",
             "newest": new_val,
             "baseline_best": baseline,
+            "direction": direction,
         }
-        if new_val is None or baseline is None or baseline <= 0:
+        if new_val is None or baseline is None or (mode == "rel" and baseline <= 0):
             cmp["verdict"] = "skipped (missing on one side)"
-        else:
-            ratio = float(new_val) / baseline
-            cmp["ratio"] = round(ratio, 4)
-            # a drop of exactly the threshold counts as a regression
-            if 1.0 - ratio >= threshold - 1e-9:
+        elif mode == "abs":
+            delta = float(new_val) - baseline if lower else baseline - float(new_val)
+            cmp["delta"] = round(delta, 4)
+            if delta >= abs_delta - 1e-9:
                 cmp["verdict"] = "REGRESSION"
                 report["ok"] = False
                 report["failures"].append(
-                    f"{label}{tag} regressed {1 - ratio:.0%}: {new_val} vs best prior "
+                    f"{label}{tag} worsened by {delta:+.3f}: {new_val} vs best prior "
+                    f"{baseline} ({src_file}, abs threshold {abs_delta})"
+                )
+            else:
+                cmp["verdict"] = "ok"
+        else:
+            ratio = float(new_val) / baseline
+            cmp["ratio"] = round(ratio, 4)
+            # a change of exactly the threshold counts as a regression
+            worsening = ratio - 1.0 if lower else 1.0 - ratio
+            if worsening >= threshold - 1e-9:
+                cmp["verdict"] = "REGRESSION"
+                report["ok"] = False
+                report["failures"].append(
+                    f"{label}{tag} regressed {worsening:.0%}: {new_val} vs best prior "
                     f"{baseline} ({src_file}, threshold {threshold:.0%})"
                 )
             else:
@@ -178,6 +245,8 @@ def compare(
     records: List[Dict[str, Any]],
     threshold: float = 0.2,
     multichip: Optional[List[Dict[str, Any]]] = None,
+    serve: Optional[List[Dict[str, Any]]] = None,
+    shed_delta: float = DEFAULT_SHED_DELTA,
 ) -> Dict[str, Any]:
     """Gate the newest usable record against the best comparable prior one.
     Returns {ok, failures[], comparisons[], note?}."""
@@ -214,6 +283,36 @@ def compare(
         # per-unit extra legs (dv3_step compute-only, fleet e2e, ...)
         _gate_extra_legs(report, newest, usable[:-1], threshold)
 
+    # the serve gate is its own trajectory: SERVE_*.json rounds judged only
+    # against each other (per unit + platform class), with the lower-is-
+    # better direction the records declare
+    if serve:
+        if not serve[-1]["_usable"]:
+            report["ok"] = False
+            report["failures"].append(
+                f"newest serve-bench round {serve[-1]['_file']} is unusable "
+                f"(rc={serve[-1]['_rc']}) — schema-invalid record or nonzero acked loss"
+            )
+        usable_serve = [r for r in serve if r["_usable"]]
+        if usable_serve:
+            newest_s = usable_serve[-1]
+            priors_s = [r for r in usable_serve[:-1] if _comparable(newest_s, r)]
+            report["newest_serve"] = {
+                "file": newest_s["_file"],
+                "unit": newest_s.get("unit"),
+                "platform_class": platform_class(newest_s),
+            }
+            _gate_fields(
+                report,
+                newest_s,
+                priors_s,
+                threshold,
+                newest_s["_file"],
+                unit="serve",
+                fields=SERVE_GATED_FIELDS,
+                abs_delta=shed_delta,
+            )
+
     # the multichip gate runs even with no (usable) BENCH records — a
     # MULTICHIP-only trajectory still has an ok→fail flip to catch
 
@@ -239,7 +338,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--dir", default=str(Path(__file__).resolve().parent.parent),
                     help="directory holding BENCH_*.json / MULTICHIP_*.json (default: repo root)")
     ap.add_argument("--threshold", type=float, default=0.2,
-                    help="allowed fractional drop vs the best comparable prior record")
+                    help="allowed fractional change for the worse vs the best comparable prior record")
+    ap.add_argument("--shed-delta", type=float, default=DEFAULT_SHED_DELTA,
+                    help="allowed ABSOLUTE shed-rate increase vs the best comparable prior serve round")
     ap.add_argument("--json", action="store_true", help="print the report as JSON")
     ap.add_argument("--dry-run", action="store_true",
                     help="full comparison + report, but exit 0 even on regression "
@@ -249,24 +350,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         records = load_trajectory(args.dir)
         multichip = load_multichip(args.dir)
+        serve = load_serve_trajectory(args.dir)
     except RuntimeError as err:
         print(f"[bench_compare] {err}", file=sys.stderr)
         return 1
-    if not records and not multichip:
+    if not records and not multichip and not serve:
         print(f"[bench_compare] no BENCH_*.json under {args.dir}; nothing to gate", file=sys.stderr)
         return 0
-    report = compare(records, threshold=args.threshold, multichip=multichip)
+    report = compare(records, threshold=args.threshold, multichip=multichip,
+                     serve=serve, shed_delta=args.shed_delta)
 
     if args.json:
         print(json.dumps(report, indent=1))
     else:
-        print(f"bench gate over {len(records)} BENCH + {len(multichip)} MULTICHIP records "
-              f"(threshold {args.threshold:.0%})")
+        print(f"bench gate over {len(records)} BENCH + {len(multichip)} MULTICHIP "
+              f"+ {len(serve)} SERVE records (threshold {args.threshold:.0%})")
         if report.get("note"):
             print(f"  note: {report['note']}")
         if report.get("newest"):
             n = report["newest"]
             print(f"  newest: {n['file']} unit={n['unit']!r} platform_class={n['platform_class']}")
+        if report.get("newest_serve"):
+            n = report["newest_serve"]
+            print(f"  newest serve: {n['file']} unit={n['unit']!r} platform_class={n['platform_class']}")
         for cmp in report["comparisons"]:
             print(f"  {cmp['metric']}: newest={cmp['newest']} baseline_best={cmp['baseline_best']} "
                   f"-> {cmp['verdict']}")

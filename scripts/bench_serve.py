@@ -1,0 +1,424 @@
+#!/usr/bin/env python
+"""Serving-gateway load bench: 10k sticky sessions against N replicas.
+
+Drives the multi-replica gateway (`sheeprl_tpu/gateway/`) with synthetic
+counter replicas — the full serve stack (MicroBatcher, bucketed jitted
+apply, SessionStore, HTTP) in real spawned processes, minus the model — and
+records the serving SLOs into a schema'd ``SERVE_rNN.json`` next to the
+``BENCH_*`` artifacts, gated run-over-run by ``scripts/bench_compare.py``
+(lower-is-better direction):
+
+* **closed-loop leg** — ``--workers`` threads each own a slice of
+  ``--sessions`` sticky sessions and step them round-robin, one in-flight
+  request per worker. Every acked action is checked against the session's
+  acked-step count (the synthetic policy echoes its pre-step counter), so
+  *any* acked-state loss — a skipped or replayed step across failover,
+  migration or 410 re-hydration — is a counted mismatch, not a silent pass.
+* **open-loop leg** — a dispatcher fires sessionless requests at
+  ``--open-rate`` rps regardless of completions (the overload probe that
+  makes admission control actually shed); ``--low-frac`` of closed-loop
+  traffic is marked ``deterministic`` and classifies low-priority.
+* **failover leg** (``--failover``, default on) — SIGKILLs one routable
+  replica at mid-run, exactly like an OOM kill. Recovery time is measured
+  until the fleet is back to its pre-kill routable width; acked-request
+  loss must be zero (the broker replays unacked steps from the last acked
+  latent).
+
+The smoke used in CI::
+
+    python scripts/bench_serve.py --sessions 1000 --replicas 2 \
+        --duration-s 20 --workers 32
+
+The full run: ``--sessions 10000 --workers 64 --duration-s 120``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+# -- stats ---------------------------------------------------------------------
+class LoadStats:
+    """Thread-safe counters + latency reservoir for one bench run."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.acked = 0
+        self.shed = 0
+        self.errors = 0
+        self.mismatches = 0  # acked-state loss: action != acked-step count
+        self.latencies_ms: List[float] = []
+
+    def record(self, status: int, dt_s: float, mismatch: bool = False) -> None:
+        with self._lock:
+            self.requests += 1
+            if status == 200:
+                self.acked += 1
+                self.latencies_ms.append(dt_s * 1000.0)
+                if mismatch:
+                    self.mismatches += 1
+            elif status == 503:
+                self.shed += 1
+            else:
+                self.errors += 1
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            lat = sorted(self.latencies_ms)
+        if not lat:
+            return 0.0
+        idx = min(len(lat) - 1, max(0, int(round(p * (len(lat) - 1)))))
+        return lat[idx]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "requests": self.requests,
+                "acked": self.acked,
+                "shed": self.shed,
+                "errors": self.errors,
+                "mismatches": self.mismatches,
+            }
+
+
+# -- traffic -------------------------------------------------------------------
+def closed_loop_worker(
+    gw: Any,
+    sessions: List[str],
+    expected: Dict[str, int],
+    stats: LoadStats,
+    stop: threading.Event,
+    low_frac: float,
+    seed: int,
+) -> None:
+    """Step this worker's sessions round-robin, one in-flight request at a
+    time; follow the server's counter on mismatch so one lost step is one
+    counted incident, not a mismatch on every subsequent step."""
+    import random
+
+    rng = random.Random(seed)
+    while not stop.is_set():
+        for sid in sessions:
+            if stop.is_set():
+                return
+            payload = {
+                "obs": {"x": [[float(expected[sid])]]},
+                "session_id": sid,
+                "deterministic": rng.random() < low_frac,
+            }
+            t0 = time.monotonic()
+            try:
+                status, body, _ = gw.handle_act(payload)
+            except Exception:
+                stats.record(500, time.monotonic() - t0)
+                continue
+            dt = time.monotonic() - t0
+            if status == 200:
+                action = float(body["actions"][0][0])
+                mismatch = action != float(expected[sid])
+                stats.record(200, dt, mismatch=mismatch)
+                expected[sid] = int(action) + 1
+            else:
+                stats.record(status, dt)
+                if status == 503:
+                    # honor a fraction of the jittered Retry-After hint so the
+                    # closed loop backs off without stalling the whole slice
+                    time.sleep(min(0.05, float(body.get("retry_after_s") or 0.05)))
+
+
+def open_loop_dispatcher(
+    gw: Any, rate_per_s: float, stats: LoadStats, stop: threading.Event, pool: int = 16
+) -> List[threading.Thread]:
+    """Fire sessionless requests at a fixed offered rate, independent of
+    completions — the overload probe. A bounded thread pool absorbs the
+    in-flight requests; when all slots are busy the dispatcher itself counts
+    the would-be request as shed (the fleet is saturated either way)."""
+    if rate_per_s <= 0:
+        return []
+    sem = threading.Semaphore(pool)
+
+    def one_shot() -> None:
+        t0 = time.monotonic()
+        try:
+            status, _, _ = gw.handle_act({"obs": {"x": [[0.0]]}})
+            stats.record(status, time.monotonic() - t0)
+        except Exception:
+            stats.record(500, time.monotonic() - t0)
+        finally:
+            sem.release()
+
+    def dispatch() -> None:
+        period = 1.0 / rate_per_s
+        nxt = time.monotonic()
+        while not stop.is_set():
+            now = time.monotonic()
+            if now < nxt:
+                time.sleep(min(period, nxt - now))
+                continue
+            nxt += period
+            if sem.acquire(blocking=False):
+                threading.Thread(target=one_shot, daemon=True).start()
+            else:
+                stats.record(503, 0.0)
+
+    t = threading.Thread(target=dispatch, daemon=True, name="open-loop")
+    t.start()
+    return [t]
+
+
+# -- failover ------------------------------------------------------------------
+def kill_one_replica(manager: Any) -> Optional[Dict[str, Any]]:
+    """SIGKILL one routable replica (external death — the supervisor finds
+    out the hard way) and return what recovery must restore."""
+    routable = manager.routable()
+    if not routable:
+        return None
+    victim = routable[0]
+    pre_routable = len(routable)
+    pid = victim.proc.pid if victim.proc is not None else None
+    if pid is None:
+        return None
+    os.kill(pid, signal.SIGKILL)
+    return {
+        "killed_replica": victim.replica_id,
+        "pid": pid,
+        "pre_routable": pre_routable,
+        "t_kill": time.monotonic(),
+    }
+
+
+def wait_recovered(manager: Any, kill: Dict[str, Any], timeout_s: float = 120.0) -> float:
+    """Seconds from SIGKILL until the fleet is back at its pre-kill routable
+    width (detection + backoff + respawn + warmup + ready); -1 on timeout."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if len(manager.routable()) >= kill["pre_routable"]:
+            return time.monotonic() - kill["t_kill"]
+        time.sleep(0.05)
+    return -1.0
+
+
+# -- record --------------------------------------------------------------------
+def next_round(out_dir: pathlib.Path) -> int:
+    rounds = [
+        int(p.stem.split("_r")[-1])
+        for p in out_dir.glob("SERVE_r*.json")
+        if p.stem.split("_r")[-1].isdigit()
+    ]
+    return max(rounds, default=0) + 1
+
+
+def prior_best_p95(out_dir: pathlib.Path, unit: str) -> Optional[float]:
+    best: Optional[float] = None
+    for path in sorted(out_dir.glob("SERVE_r*.json")):
+        try:
+            wrapper = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        rec = wrapper.get("parsed") if isinstance(wrapper, dict) else None
+        if not isinstance(rec, dict) or rec.get("unit") != unit:
+            continue
+        val = rec.get("value")
+        if isinstance(val, (int, float)) and val > 0:
+            best = val if best is None else min(best, float(val))
+    return best
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sessions", type=int, default=10_000, help="concurrent sticky sessions")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--workers", type=int, default=64, help="closed-loop driver threads")
+    ap.add_argument("--duration-s", type=float, default=120.0)
+    ap.add_argument("--open-rate", type=float, default=200.0,
+                    help="open-loop offered rate (rps); 0 disables the overload probe")
+    ap.add_argument("--low-frac", type=float, default=0.1,
+                    help="fraction of closed-loop traffic marked deterministic (low priority)")
+    ap.add_argument("--max-inflight", type=int, default=512)
+    ap.add_argument("--rate-per-s", type=float, default=0.0,
+                    help="admission token-bucket rate (0 = unlimited)")
+    ap.add_argument("--failover", dest="failover", action="store_true", default=True)
+    ap.add_argument("--no-failover", dest="failover", action="store_false")
+    ap.add_argument("--out-dir", default=str(REPO_ROOT))
+    ap.add_argument("--telemetry-dir", default="",
+                    help="also write gateway telemetry JSONL under this dir")
+    ap.add_argument("--json", action="store_true", help="print the record as JSON only")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from sheeprl_tpu.config import Config, load_config_file
+    from sheeprl_tpu.gateway.cluster import build_cluster
+    from sheeprl_tpu.telemetry.schema import validate_event
+    from sheeprl_tpu.telemetry.sinks import JsonlSink
+
+    cfg = Config({"gateway": load_config_file(
+        REPO_ROOT / "sheeprl_tpu" / "configs" / "gateway" / "default.yaml").to_dict()})
+    cfg.set_path("gateway.replicas", args.replicas)
+    cfg.set_path("gateway.http.port", 0)
+    cfg.set_path("gateway.admission.max_inflight", args.max_inflight)
+    cfg.set_path("gateway.admission.rate_per_s", args.rate_per_s)
+    # size the replica session caches to the offered session count: cache
+    # churn (410 + re-hydrate) is a failure mode the failover leg covers,
+    # not something the latency SLO should price in by default
+    cfg.set_path("gateway.replica.max_sessions", max(4096, args.sessions))
+    cfg.set_path("gateway.broker.max_sessions", max(1_000_000, 2 * args.sessions))
+
+    sink = None
+    if args.telemetry_dir:
+        sink = JsonlSink(str(pathlib.Path(args.telemetry_dir) / "telemetry.jsonl"))
+
+    t_setup = time.monotonic()
+    print(f"[bench_serve] starting {args.replicas} synthetic replicas ...", flush=True)
+    gw = build_cluster(cfg, sink=sink, start=True)
+    manager = gw.manager
+    try:
+        if len(manager.routable()) < args.replicas:
+            raise RuntimeError(
+                f"fleet not routable: {len(manager.routable())}/{args.replicas}"
+            )
+        print(
+            f"[bench_serve] fleet up in {time.monotonic() - t_setup:.1f}s; "
+            f"driving {args.sessions} sessions with {args.workers} workers "
+            f"for {args.duration_s:.0f}s (open-loop {args.open_rate:.0f} rps)",
+            flush=True,
+        )
+
+        stats = LoadStats()
+        stop = threading.Event()
+        expected: Dict[str, int] = {f"s{i:06d}": 0 for i in range(args.sessions)}
+        sids = list(expected)
+        threads: List[threading.Thread] = []
+        for w in range(args.workers):
+            slice_ = sids[w :: args.workers]
+            if not slice_:
+                continue
+            t = threading.Thread(
+                target=closed_loop_worker,
+                args=(gw, slice_, expected, stats, stop, args.low_frac, 1000 + w),
+                daemon=True,
+                name=f"closed-{w}",
+            )
+            t.start()
+            threads.append(t)
+        threads += open_loop_dispatcher(gw, args.open_rate, stats, stop)
+
+        t0 = time.monotonic()
+        failover: Dict[str, Any] = {}
+        kill = None
+        while time.monotonic() - t0 < args.duration_s:
+            time.sleep(0.25)
+            if args.failover and kill is None and time.monotonic() - t0 >= args.duration_s / 2:
+                kill = kill_one_replica(manager)
+                if kill:
+                    print(
+                        f"[bench_serve] t+{time.monotonic() - t0:.1f}s: SIGKILL replica "
+                        f"{kill['killed_replica']} (pid {kill['pid']})",
+                        flush=True,
+                    )
+        if kill:
+            recovery_s = wait_recovered(manager, kill)
+            failover = {
+                "killed_replica": kill["killed_replica"],
+                "recovery_s": round(recovery_s, 3),
+                "acked_loss": stats.snapshot()["mismatches"],
+            }
+            print(
+                f"[bench_serve] failover: recovered in {recovery_s:.1f}s, "
+                f"acked loss {failover['acked_loss']}",
+                flush=True,
+            )
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        duration_s = time.monotonic() - t0
+    finally:
+        stop_err = None
+        try:
+            gw.stop()
+        except Exception as e:  # shutdown must not eat the record
+            stop_err = e
+        manager.shutdown()
+        if sink is not None:
+            sink.close()
+
+    snap = stats.snapshot()
+    unit = f"gateway act p95 ms ({args.sessions} sessions x {args.replicas} replicas)"
+    value = round(stats.percentile(0.95), 3)
+    best_prior = prior_best_p95(pathlib.Path(args.out_dir), unit)
+    shed_rate = snap["shed"] / snap["requests"] if snap["requests"] else 0.0
+    record: Dict[str, Any] = {
+        "event": "serve_bench",
+        "metric": (
+            f"gateway load bench: {args.sessions} sticky sessions, "
+            f"{args.replicas} synthetic replicas, closed+open loop"
+            + (", 1 replica SIGKILLed mid-run" if failover else "")
+        ),
+        "value": value,
+        "unit": unit,
+        "direction": "lower",
+        "vs_baseline": round(best_prior / value, 4) if best_prior and value > 0 else 1.0,
+        "p50_ms": round(stats.percentile(0.50), 3),
+        "p95_ms": value,
+        "p99_ms": round(stats.percentile(0.99), 3),
+        "shed_rate": round(shed_rate, 4),
+        "error_rate": round(snap["errors"] / snap["requests"], 4) if snap["requests"] else 0.0,
+        "requests": snap["requests"],
+        "acked": snap["acked"],
+        "throughput_rps": round(snap["acked"] / duration_s, 1) if duration_s > 0 else 0.0,
+        "sessions": args.sessions,
+        "replicas": args.replicas,
+        "concurrency": args.workers,
+        "duration_s": round(duration_s, 1),
+        "platform": "cpu",
+    }
+    if failover:
+        record["failover"] = failover
+    problems = validate_event(record)
+    if problems:
+        print(f"[bench_serve] SCHEMA-INVALID record: {problems}", file=sys.stderr)
+
+    out_dir = pathlib.Path(args.out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    round_n = next_round(out_dir)
+    wrapper = {
+        "n": round_n,
+        "cmd": "python scripts/bench_serve.py " + " ".join(argv or sys.argv[1:]),
+        "rc": 0 if not problems and snap["mismatches"] == 0 else 1,
+        "parsed": record,
+    }
+    out_path = out_dir / f"SERVE_r{round_n:02d}.json"
+    out_path.write_text(json.dumps(wrapper, indent=1) + "\n")
+    if args.json:
+        print(json.dumps(record, indent=1))
+    else:
+        print(
+            f"[bench_serve] {out_path.name}: p50={record['p50_ms']}ms "
+            f"p95={record['p95_ms']}ms p99={record['p99_ms']}ms "
+            f"shed={record['shed_rate']:.1%} err={record['error_rate']:.2%} "
+            f"rps={record['throughput_rps']} acked={record['acked']}"
+            + (
+                f" | failover: recovery {failover['recovery_s']}s "
+                f"acked_loss={failover['acked_loss']}"
+                if failover
+                else ""
+            ),
+            flush=True,
+        )
+    if stop_err is not None:
+        print(f"[bench_serve] gateway stop error: {stop_err!r}", file=sys.stderr)
+    return wrapper["rc"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

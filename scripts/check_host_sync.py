@@ -15,7 +15,8 @@ Scope (deliberately narrow, to stay precise):
   fleet worker loop) in the given files/dirs (default:
   ``sheeprl_tpu/algos`` + ``sheeprl_tpu/fleet`` — the worker step path must
   stay host-sync clean too: a hidden sync there stalls every env slice the
-  worker owns);
+  worker owns — + ``sheeprl_tpu/gateway``, whose supervision/serving loops
+  must never block on a device either);
 * only statements inside a ``while``/``for`` loop in those functions — the
   hot path, not setup code.
 
@@ -184,6 +185,7 @@ def main(argv: List[str]) -> int:
     paths = [Path(a) for a in argv] or [
         repo / "sheeprl_tpu" / "algos",
         repo / "sheeprl_tpu" / "fleet",
+        repo / "sheeprl_tpu" / "gateway",
     ]
     violations = check_paths(paths)
     for path, lineno, msg in violations:

@@ -2,10 +2,11 @@
 # Single lint/gate entry point, wired into tier-1 (tests/test_lint.py) so
 # neither check can silently rot:
 #   * scripts/check_host_sync.py — the AST lint against hidden device→host
-#     syncs in the training hot loops (sheeprl_tpu/algos) AND the fleet
-#     worker step path (sheeprl_tpu/fleet — its default scan set);
+#     syncs in the training hot loops (sheeprl_tpu/algos), the fleet worker
+#     step path (sheeprl_tpu/fleet) AND the serving-gateway loops
+#     (sheeprl_tpu/gateway) — its default scan set;
 #   * scripts/bench_compare.py --dry-run — the bench regression gate run
-#     over the repo's recorded BENCH_*/MULTICHIP_* trajectory (full
+#     over the repo's recorded BENCH_*/MULTICHIP_*/SERVE_* trajectory (full
 #     comparison + report; --dry-run keeps a slower CI host from failing
 #     unrelated changes, while unreadable/rotten artifacts still fail).
 # CI that wants the gate to BLOCK on regression runs bench_compare without

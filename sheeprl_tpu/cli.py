@@ -265,6 +265,29 @@ def serve(args: Optional[Sequence[str]] = None) -> None:
     serve_from_checkpoint(ckpt_path, cfg)
 
 
+def gateway(args: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl_tpu gateway checkpoint_path=... [gateway.replicas=4 ...]` —
+    serve a trained checkpoint behind the multi-replica gateway
+    (gateway/cluster.py): N supervised PolicyServer replica processes,
+    sticky-session routing with broker-backed failover, admission control
+    and rolling checkpoint hot-reload."""
+    argv = list(args if args is not None else sys.argv[1:])
+    import sheeprl_tpu  # ensure registries are populated
+    from .config.compose import CONFIG_ROOT
+
+    ckpt_path, rest = _split_checkpoint_arg(argv, "gateway")
+    cfg = _load_config_beside(ckpt_path)
+    # saved run configs predate the serve/gateway groups: compose defaults in
+    for group in ("serve", "gateway"):
+        if cfg.select(group) is None:
+            cfg[group] = load_config_file(CONFIG_ROOT / group / "default.yaml")
+    _apply_cli_overrides(cfg, rest)
+    cfg["checkpoint_path"] = str(ckpt_path)
+    from .gateway.cluster import gateway_from_checkpoint
+
+    gateway_from_checkpoint(ckpt_path, cfg)
+
+
 def resume(args: Optional[Sequence[str]] = None) -> None:
     """`sheeprl_tpu resume run_dir=<logs/runs/.../version_N> [key=value ...]`
     — relaunch a preempted/crashed run from its newest complete checkpoint
@@ -360,10 +383,10 @@ def available_agents() -> None:
 
 
 def main() -> None:
-    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|doctor|registration|agents> ...`"""
+    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|gateway|doctor|registration|agents> ...`"""
     argv = sys.argv[1:]
     if argv and argv[0] in (
-        "run", "eval", "evaluation", "resume", "serve", "doctor", "registration", "agents"
+        "run", "eval", "evaluation", "resume", "serve", "gateway", "doctor", "registration", "agents"
     ):
         cmd, rest = argv[0], argv[1:]
     else:
@@ -376,6 +399,8 @@ def main() -> None:
         resume(rest)
     elif cmd == "serve":
         serve(rest)
+    elif cmd == "gateway":
+        gateway(rest)
     elif cmd == "doctor":
         doctor(rest)
     elif cmd == "registration":

@@ -426,6 +426,104 @@ def detect_quarantine(tl: Timeline, cfg: Any = None) -> List[Finding]:
     ]
 
 
+def detect_replica_flap(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Serving replicas dying or hanging behind the gateway. Unlike fleet
+    env workers (where flap needs repetition to matter), a single replica
+    fault forces live sessions to migrate through the broker and costs a
+    full respawn (interpreter + jax + warmup) of serving capacity — so the
+    default threshold is ONE fault."""
+    min_faults = int(_sel(cfg, "diag.gateway.min_faults", 1))
+    faults = [rec for rec in tl.of("replica") if rec.get("action") in ("crash", "hang")]
+    if len(faults) < min_faults:
+        return []
+    per_replica: Dict[Any, int] = {}
+    for rec in faults:
+        per_replica[rec.get("replica")] = per_replica.get(rec.get("replica"), 0) + 1
+    worst_replica, worst = max(per_replica.items(), key=lambda kv: kv[1])
+    kinds = {rec.get("action") for rec in faults}
+    quarantined = sorted(
+        {rec.get("replica") for rec in tl.of("replica") if rec.get("action") == "quarantine"}
+    )
+    gw = tl.of("gateway")
+    failovers = int(gw[-1].get("failovers") or 0) if gw else 0
+    migrations = int(gw[-1].get("migrations") or 0) if gw else 0
+    return [
+        Finding(
+            code="replica_flap",
+            severity="critical" if quarantined else "warning",
+            title=(
+                f"serving replica flap: {len(faults)} fault(s) across "
+                f"{len(per_replica)} replica(s) ({', '.join(sorted(kinds))})"
+                + (f"; {quarantined} QUARANTINED" if quarantined else "")
+            ),
+            detail=(
+                f"Worst offender: replica {worst_replica} with {worst} fault(s). "
+                f"The gateway absorbed {failovers} failover(s) and migrated "
+                f"{migrations} session(s) through the broker; each respawn costs "
+                "a full process + warmup before the slot serves again."
+            ),
+            remediation=(
+                "Check the replica's stderr for the crash traceback (the gateway "
+                "log carries `[gateway] replica N fault: ...` lines). Raise "
+                "`gateway.supervisor.hang_s` if slow checkpoint reloads are being "
+                "mistaken for hangs; `gateway.supervisor.max_fails`/`fail_window_s` "
+                "tune when flap becomes quarantine. Quarantined slots need a "
+                "gateway restart after the underlying cause is fixed."
+            ),
+            data={
+                "faults": len(faults),
+                "per_replica": {str(k): v for k, v in per_replica.items()},
+                "quarantined": [int(q) for q in quarantined if q is not None],
+                "failovers": failovers,
+                "migrations": migrations,
+            },
+        )
+    ]
+
+
+def detect_gateway_shedding(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Sustained admission-control shedding: occasional sheds are the system
+    working as designed; a high shed fraction means the fleet is
+    under-provisioned for the offered load."""
+    shed_frac = float(_sel(cfg, "diag.gateway.shed_frac", 0.05))
+    snaps = tl.of("gateway")
+    if not snaps:
+        return []
+    last = snaps[-1]
+    requests = float(last.get("requests") or 0)
+    shed = float(last.get("admission_shed") or 0)
+    if requests <= 0 or shed / requests < shed_frac:
+        return []
+    frac = shed / requests
+    shed_low = float(last.get("admission_shed_low") or 0)
+    return [
+        Finding(
+            code="gateway_shedding",
+            severity="warning",
+            title=f"gateway shed {frac:.1%} of traffic ({int(shed)}/{int(requests)} requests)",
+            detail=(
+                f"Admission control rejected {int(shed)} request(s) "
+                f"({int(shed_low)} low-priority) with jittered Retry-After; "
+                f"p95 latency of admitted traffic: {last.get('p95_ms', 'n/a')} ms."
+            ),
+            remediation=(
+                "Add replicas (`gateway.replicas`) or raise "
+                "`gateway.admission.max_inflight`/`rate_per_s` if the replicas "
+                "have headroom (check their /stats batch occupancy). If only "
+                "low-priority traffic is shed, the system is protecting "
+                "interactive sessions as configured — consider scheduling eval "
+                "sweeps off-peak instead."
+            ),
+            data={
+                "shed": int(shed),
+                "shed_low": int(shed_low),
+                "requests": int(requests),
+                "shed_frac": round(frac, 4),
+            },
+        )
+    ]
+
+
 def detect_incomplete_stream(tl: Timeline, cfg: Any = None) -> List[Finding]:
     """No shutdown event: the process died without closing telemetry — a
     crash, OOM-kill or external SIGKILL (a clean preemption still writes
@@ -466,6 +564,8 @@ DETECTORS: List[Callable[[Timeline, Any], List[Finding]]] = [
     detect_worker_flap,
     detect_fleet_degraded,
     detect_quarantine,
+    detect_replica_flap,
+    detect_gateway_shedding,
     detect_incomplete_stream,
 ]
 

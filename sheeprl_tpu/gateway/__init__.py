@@ -1,0 +1,38 @@
+"""Multi-replica serving gateway: sticky sessions, admission control, failover.
+
+    from sheeprl_tpu.gateway import gateway_from_checkpoint
+    gw = gateway_from_checkpoint("…/ckpt_1024.ckpt", cfg, block=False)
+    # POST http://gw.host:gw.port/v1/act — same wire protocol as one replica
+
+The split (MindSpeed-RL's decoupled dataflow, RLAX's versioned param fleets):
+
+* **request-routing plane** — `Gateway` + `Router` + `AdmissionController`
+  + `SessionBroker` (this package): admits, routes sticky sessions, sheds
+  with jittered Retry-After, owns the authoritative session latents;
+* **model-execution plane** — N `PolicyServer` replica processes under the
+  `ReplicaManager` supervision tree (heartbeat watchdog, jittered-backoff
+  respawn, fail budget → quarantine, rolling drain for hot reload).
+
+See ``howto/serving.md`` ("Scaling out with the gateway").
+"""
+from .admission import AdmissionController, Shed
+from .broker import SessionBroker
+from .cluster import build_cluster, gateway_from_checkpoint
+from .gateway import Gateway, GatewayStats, NoReplicasAvailable, Router
+from .replica import ReplicaHandle, ReplicaManager, replica_entry, synthetic_counter_core
+
+__all__ = [
+    "AdmissionController",
+    "Gateway",
+    "GatewayStats",
+    "NoReplicasAvailable",
+    "ReplicaHandle",
+    "ReplicaManager",
+    "Router",
+    "SessionBroker",
+    "Shed",
+    "build_cluster",
+    "gateway_from_checkpoint",
+    "replica_entry",
+    "synthetic_counter_core",
+]

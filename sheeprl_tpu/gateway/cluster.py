@@ -1,0 +1,123 @@
+"""Cluster assembly: config → ReplicaManager + SessionBroker + Gateway.
+
+One builder for every consumer — the ``sheeprl_tpu gateway`` CLI (checkpoint
+replicas), the load bench and the failover tests (synthetic replicas) — so
+the wiring is identical wherever the cluster runs.
+"""
+from __future__ import annotations
+
+import pathlib
+from typing import Any, Optional
+
+from .admission import AdmissionController
+from .broker import SessionBroker
+from .gateway import Gateway
+from .replica import ReplicaManager
+
+__all__ = ["build_cluster", "gateway_from_checkpoint"]
+
+
+def build_cluster(
+    cfg: Any,
+    ckpt_path: Optional[Any] = None,
+    sink: Any = None,
+    start: bool = True,
+) -> Gateway:
+    """Build (and optionally start) the full serving cluster from the
+    ``gateway`` config group. With ``ckpt_path`` the replicas serve the real
+    checkpoint (the run's saved config rides into each replica process);
+    without it they run the synthetic counter policy — the load-bench and
+    chaos-test fleet."""
+    sel = cfg.select if hasattr(cfg, "select") else (lambda p, d=None: d)
+
+    spec_base: dict = {
+        "buckets": list(sel("gateway.replica.buckets", [1, 2, 4, 8, 16]) or [1, 2, 4, 8, 16]),
+        "max_wait_ms": float(sel("gateway.replica.max_wait_ms", 5.0)),
+        "max_pending": int(sel("gateway.replica.max_pending", 256)),
+        "max_sessions": int(sel("gateway.replica.max_sessions", 4096)),
+        "request_timeout_s": float(sel("gateway.replica.request_timeout_s", 30.0)),
+        "slow_ms": float(sel("gateway.replica.slow_ms", 0.0) or 0.0),
+    }
+    if ckpt_path is not None:
+        spec_base.update(
+            mode="checkpoint",
+            ckpt_path=str(pathlib.Path(ckpt_path)),
+            cfg=cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg),
+            hot_reload={
+                "enabled": bool(sel("gateway.replica.hot_reload.enabled", True)),
+                "poll_interval_s": float(sel("gateway.replica.hot_reload.poll_interval_s", 2.0)),
+            },
+        )
+    else:
+        spec_base["mode"] = "synthetic"
+    chaos = sel("gateway.replica.chaos")
+    if chaos:
+        spec_base["chaos"] = chaos.to_dict() if hasattr(chaos, "to_dict") else dict(chaos)
+
+    manager = ReplicaManager(
+        spec_base,
+        num_replicas=int(sel("gateway.replicas", 2)),
+        sink=sink,
+        host=str(sel("gateway.http.host", "127.0.0.1")),
+        replica_platform=str(sel("gateway.replica.platform", "cpu")),
+        health_poll_s=float(sel("gateway.supervisor.health_poll_s", 0.5)),
+        health_timeout_s=float(sel("gateway.supervisor.health_timeout_s", 2.0)),
+        hang_s=float(sel("gateway.supervisor.hang_s", 10.0)),
+        spawn_grace_s=float(sel("gateway.supervisor.spawn_grace_s", 120.0)),
+        backoff_s=float(sel("gateway.supervisor.backoff_s", 0.5)),
+        max_backoff_s=float(sel("gateway.supervisor.max_backoff_s", 30.0)),
+        jitter=float(sel("gateway.supervisor.jitter", 0.5)),
+        max_fails=int(sel("gateway.supervisor.max_fails", 3)),
+        fail_window_s=float(sel("gateway.supervisor.fail_window_s", 300.0)),
+    )
+    gateway = Gateway(
+        manager,
+        broker=SessionBroker(int(sel("gateway.broker.max_sessions", 1_000_000))),
+        admission=AdmissionController(
+            rate_per_s=float(sel("gateway.admission.rate_per_s", 0.0) or 0.0),
+            burst=int(sel("gateway.admission.burst", 256)),
+            max_inflight=int(sel("gateway.admission.max_inflight", 512)),
+            low_priority_frac=float(sel("gateway.admission.low_priority_frac", 0.8)),
+            retry_after_s=float(sel("gateway.admission.retry_after_s", 0.25)),
+            jitter=float(sel("gateway.admission.jitter", 0.5)),
+        ),
+        host=str(sel("gateway.http.host", "127.0.0.1")),
+        port=int(sel("gateway.http.port", 8090)),
+        forward_timeout_s=float(sel("gateway.forward_timeout_s", 30.0)),
+        max_attempts=int(sel("gateway.max_attempts", 3)),
+        shed_deterministic=bool(sel("gateway.admission.shed_deterministic", True)),
+        max_pins=int(sel("gateway.router.max_pins", 1_000_000)),
+        sink=sink,
+        log_every_s=float(sel("gateway.telemetry.log_every_s", 10.0)),
+    )
+    if start:
+        manager.start()
+        manager.wait_routable(timeout_s=float(sel("gateway.supervisor.spawn_grace_s", 120.0)))
+        gateway.start()
+    return gateway
+
+
+def gateway_from_checkpoint(ckpt_path: Any, cfg: Any, block: bool = True) -> Gateway:
+    """The ``sheeprl_tpu gateway`` entrypoint's workhorse: checkpoint → N
+    supervised PolicyServer replicas behind one gateway, with ``gateway``
+    telemetry JSONL written next to the run."""
+    from ..telemetry.sinks import JsonlSink
+
+    ckpt_path = pathlib.Path(ckpt_path)
+    sel = cfg.select
+    sink = None
+    if bool(sel("gateway.telemetry.jsonl", True)):
+        run_dir = ckpt_path.parent.parent
+        sink = JsonlSink(str(run_dir / "gateway" / "telemetry.jsonl"))
+    gateway = build_cluster(cfg, ckpt_path=ckpt_path, sink=sink, start=True)
+    print(
+        f"[gateway] {gateway.manager.num_replicas} replica(s) behind "
+        f"http://{gateway.host}:{gateway.port}",
+        flush=True,
+    )
+    if block:
+        try:
+            gateway.serve_forever()
+        finally:
+            gateway.manager.shutdown()
+    return gateway

@@ -5,12 +5,20 @@
     actions = server.act({"state": obs_vec})
 
 See ``howto/serving.md`` for bucketing, backpressure and hot-reload
-semantics.
+semantics; multi-replica scale-out lives in ``sheeprl_tpu/gateway/``.
 """
-from .batcher import Backpressure, MicroBatcher, ServeStats
-from .policy import InferencePolicy, PolicyCore, SessionStore, env_action, register_policy_builder
+from .batcher import Backpressure, MicroBatcher, ServeStats, jittered_retry_after
+from .policy import (
+    InferencePolicy,
+    PolicyCore,
+    SessionExpired,
+    SessionStore,
+    env_action,
+    register_policy_builder,
+)
 from .reload import CheckpointReloader
 from .server import PolicyServer, serve_from_checkpoint
+from .session_codec import StateDecodeError, decode_state, encode_state
 
 __all__ = [
     "Backpressure",
@@ -20,8 +28,13 @@ __all__ = [
     "PolicyCore",
     "PolicyServer",
     "ServeStats",
+    "SessionExpired",
     "SessionStore",
+    "StateDecodeError",
+    "decode_state",
+    "encode_state",
     "env_action",
+    "jittered_retry_after",
     "register_policy_builder",
     "serve_from_checkpoint",
 ]

@@ -20,12 +20,25 @@ events on the shared telemetry JSONL stream.
 """
 from __future__ import annotations
 
+import random
 import threading
 import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional
 
-from .policy import InferencePolicy
+from .policy import InferencePolicy, SessionExpired
+
+
+def jittered_retry_after(base_s: float, jitter: float = 0.5, floor_s: float = 0.05) -> float:
+    """Spread a Retry-After estimate upward by up to ``jitter`` of itself.
+
+    A constant Retry-After synchronizes every shed client into one retry
+    wave that saturates the queue all over again; jittering upward keeps the
+    estimate honest as a *minimum* while de-correlating the herd. Shared by
+    the MicroBatcher's :class:`Backpressure` and the gateway's admission
+    controller — one shedding policy across the serving tier."""
+    base_s = max(float(floor_s), float(base_s))
+    return base_s * (1.0 + random.uniform(0.0, max(0.0, float(jitter))))
 
 
 class Backpressure(RuntimeError):
@@ -69,6 +82,8 @@ class ServeStats:
         self.completed = 0
         self.rejected = 0
         self.errors = 0
+        self.evictions = 0
+        self.expired = 0
         self.batches = 0
         self.batched_items = 0
         self._occupancy_sum = 0.0
@@ -97,6 +112,16 @@ class ServeStats:
         with self._lock:
             self.rejected += 1
         self._m_rejected.inc()
+
+    def record_eviction(self) -> None:
+        with self._lock:
+            self.evictions += 1
+        self.registry.counter("session_evictions_total", "live sessions LRU-evicted").inc()
+
+    def record_expired(self) -> None:
+        with self._lock:
+            self.expired += 1
+        self.registry.counter("session_expired_total", "requests answered 410 session_expired").inc()
 
     def record_batch(self, n: int, bucket: int, seconds: float) -> None:
         with self._lock:
@@ -127,6 +152,8 @@ class ServeStats:
                 "completed": self.completed,
                 "rejected": self.rejected,
                 "errors": self.errors,
+                "evictions": self.evictions,
+                "expired": self.expired,
                 "batches": self.batches,
                 "batch_occupancy": round(self._occupancy_sum / self.batches, 4)
                 if self.batches
@@ -164,6 +191,22 @@ class MicroBatcher:
         self._cv = threading.Condition()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # count + report live-session evictions (LRU overflow): the store
+        # fires per evicted id, the stats counter and an immediate `session`
+        # telemetry event make the loss observable instead of silent
+        sessions = getattr(policy, "sessions", None)
+        if sessions is not None and hasattr(sessions, "on_evict"):
+            sessions.on_evict = self._on_session_evict
+
+    def _on_session_evict(self, sid: str) -> None:
+        self.stats.record_eviction()
+        if self._sink is not None:
+            try:
+                self._sink.write(
+                    {"event": "session", "action": "evicted", "session_id": str(sid)}
+                )
+            except Exception:
+                pass
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "MicroBatcher":
@@ -203,10 +246,19 @@ class MicroBatcher:
     ) -> Any:
         """Enqueue one observation; block until its action row is ready.
 
-        Raises :class:`Backpressure` when the queue is saturated and
+        Raises :class:`Backpressure` when the queue is saturated,
+        :class:`SessionExpired` when the session's state was LRU-evicted
+        (the caller must re-hydrate or restart the session) and
         ``TimeoutError`` when the request is not served within the timeout.
         """
         self.start()
+        # expired sessions fail BEFORE batching: silently re-initializing an
+        # evicted latent would corrupt the session's trajectory
+        if session is not None:
+            check = getattr(self.policy, "session_expired", None)
+            if check is not None and check(session):
+                self.stats.record_expired()
+                raise SessionExpired(session)
         prepared = self.policy.prepare(raw_obs, 1)
         # reject malformed obs here, where only THIS caller pays: inside a
         # coalesced batch it would fail every rider (or retrace a new shape)
@@ -239,7 +291,9 @@ class MicroBatcher:
     def _retry_after_locked(self) -> float:
         per_batch = self.stats.avg_batch_seconds() or self.max_wait_s or 0.05
         width = self.policy.buckets[-1]
-        return max(0.05, len(self._pending) / max(1, width) * per_batch)
+        # jittered so a burst of shed clients doesn't retry as one
+        # thundering herd at the same instant
+        return jittered_retry_after(len(self._pending) / max(1, width) * per_batch)
 
     # -- the flush loop ----------------------------------------------------
     def _take_batch_locked(self) -> List[_Request]:
@@ -279,10 +333,15 @@ class MicroBatcher:
 
         n = len(batch)
         t0 = time.monotonic()
+        expired: List[int] = []
         try:
             obs = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *[r.obs for r in batch])
             actions = self.policy.act_batch(
-                obs, n, deterministic=batch[0].deterministic, sessions=[r.session for r in batch]
+                obs,
+                n,
+                deterministic=batch[0].deterministic,
+                sessions=[r.session for r in batch],
+                expired_out=expired,
             )
         except BaseException as e:  # a bad request must not kill the server
             now = time.monotonic()
@@ -296,9 +355,19 @@ class MicroBatcher:
 
         self.stats.record_batch(n, _bucket_for(n, self.policy.buckets), dt)
         now = time.monotonic()
+        expired_set = set(expired)
         for i, req in enumerate(batch):
-            req.result = actions[i : i + 1]
-            self.stats.record_done(now - req.t_submit)
+            if i in expired_set:
+                # the session's latent fell off the LRU between submit's
+                # expiry check and the batch gather: the row ran on a
+                # throwaway initial state — failing only this rider keeps
+                # the 410 re-hydrate protocol honest under churn
+                req.error = SessionExpired(str(req.session))
+                self.stats.record_expired()
+                self.stats.record_done(now - req.t_submit, error=True)
+            else:
+                req.result = actions[i : i + 1]
+                self.stats.record_done(now - req.t_submit)
             req.event.set()
 
     # -- telemetry ---------------------------------------------------------

@@ -89,18 +89,37 @@ class PolicyCore:
         return self.init_state is not None
 
 
+class SessionExpired(KeyError):
+    """The session's recurrent state was LRU-evicted while the session was
+    still live. Re-initializing the latent silently would corrupt the
+    session's trajectory — the server answers HTTP 410 instead, and the
+    gateway re-hydrates from its broker copy."""
+
+    def __init__(self, sid: str) -> None:
+        super().__init__(f"session '{sid}' expired: its state was evicted (LRU bound)")
+        self.session_id = str(sid)
+
+
 class SessionStore:
     """Host-side per-session recurrent state rows (each a [1, ...] tree).
 
     Bounded: beyond ``max_sessions`` ids the least-recently-used row is
-    evicted (that session simply resumes from the initial state), so a
-    long-running server with per-user ids cannot leak host memory."""
+    evicted, so a long-running server with per-user ids cannot leak host
+    memory. Evicted ids leave a TOMBSTONE (itself bounded): a later request
+    for a tombstoned session is distinguishable from a brand-new session —
+    the act path raises :class:`SessionExpired` (HTTP 410) instead of
+    silently restarting the latent from the initial state. Re-hydrating the
+    session (``put``) clears its tombstone. ``on_evict(sid)`` fires per
+    eviction so the serving stats can count them."""
 
-    def __init__(self, max_sessions: int = 4096) -> None:
+    def __init__(self, max_sessions: int = 4096, max_tombstones: Optional[int] = None) -> None:
         from collections import OrderedDict
 
         self.max_sessions = int(max_sessions)
+        self.max_tombstones = int(max_tombstones if max_tombstones is not None else 4 * self.max_sessions)
+        self.on_evict: Optional[Any] = None  # callback(sid), set by the serving layer
         self._rows: "OrderedDict[str, Any]" = OrderedDict()
+        self._tombstones: "OrderedDict[str, bool]" = OrderedDict()
         self._lock = threading.Lock()
 
     def get(self, sid: str) -> Optional[Any]:
@@ -111,19 +130,41 @@ class SessionStore:
             return row
 
     def put(self, sid: str, row: Any) -> None:
+        evicted: List[str] = []
         with self._lock:
             self._rows[sid] = row
             self._rows.move_to_end(sid)
+            self._tombstones.pop(sid, None)  # (re)hydrated: no longer expired
             while len(self._rows) > self.max_sessions:
-                self._rows.popitem(last=False)
+                old_sid, _ = self._rows.popitem(last=False)
+                self._tombstones[old_sid] = True
+                self._tombstones.move_to_end(old_sid)
+                evicted.append(old_sid)
+            while len(self._tombstones) > self.max_tombstones:
+                self._tombstones.popitem(last=False)
+        # callbacks run outside the lock: an emitting sink must not block puts
+        cb = self.on_evict
+        if cb is not None:
+            for old_sid in evicted:
+                try:
+                    cb(old_sid)
+                except Exception:
+                    pass
+
+    def expired(self, sid: str) -> bool:
+        """True when this id's state was evicted and never re-hydrated."""
+        with self._lock:
+            return sid in self._tombstones
 
     def drop(self, sid: str) -> None:
         with self._lock:
             self._rows.pop(sid, None)
+            self._tombstones.pop(sid, None)
 
     def clear(self) -> None:
         with self._lock:
             self._rows.clear()
+            self._tombstones.clear()
 
     def __len__(self) -> int:
         with self._lock:
@@ -194,6 +235,11 @@ class InferencePolicy:
         )
         self.reload_count = 0
         self.params_version = 0
+        import time as _time
+
+        # monotonic stamp of the last param (re)load: /healthz reports the
+        # age so the gateway's routing can prefer fresh replicas
+        self.params_refreshed_at = _time.monotonic()
         self._init_row: Optional[Any] = None
         self._tag = _next_tag(core.name)
         # `greedy` is baked in as a closure constant (two executables per
@@ -275,14 +321,24 @@ class InferencePolicy:
         # a half-transferred tree
         for leaf in jax.tree.leaves(new):
             getattr(leaf, "block_until_ready", lambda: None)()
+        import time as _time
+
         with self._params_lock:
             self._params = new
             self.params_version += 1
             self.reload_count += 1
+            self.params_refreshed_at = _time.monotonic()
             version = self.params_version
         if self.core.stateful:
             self._refresh_init_row()
         return version
+
+    def params_staleness_s(self) -> float:
+        """Seconds since the served params were last loaded or swapped."""
+        import time as _time
+
+        with self._params_lock:
+            return max(0.0, _time.monotonic() - self.params_refreshed_at)
 
     def current_params(self) -> Tuple[Any, int]:
         with self._params_lock:
@@ -343,6 +399,26 @@ class InferencePolicy:
                     f"obs leaf {spath or 'obs'} has dtype {a.dtype}, expected {sdtype}"
                 )
 
+    # -- session externalization (gateway broker protocol) ------------------
+    def export_session(self, sid: str) -> Optional[Any]:
+        """The session's current host-side state row (None when unknown/
+        stateless) — what the replica hands back so the gateway's broker
+        stays the source of truth."""
+        if not self.core.stateful:
+            return None
+        return self.sessions.get(sid)
+
+    def import_session(self, sid: str, row: Any) -> None:
+        """Install an externalized state row (broker re-hydrate / session
+        migration). Overwrites any cached row — the broker's copy wins —
+        and clears the session's eviction tombstone."""
+        if not self.core.stateful:
+            return
+        self.sessions.put(sid, row)
+
+    def session_expired(self, sid: str) -> bool:
+        return self.core.stateful and self.sessions.expired(sid)
+
     @staticmethod
     def _stack_rows(rows: List[Any]) -> Any:
         import jax
@@ -368,6 +444,7 @@ class InferencePolicy:
         n: int,
         deterministic: bool = False,
         sessions: Optional[Sequence[Optional[str]]] = None,
+        expired_out: Optional[List[int]] = None,
     ) -> np.ndarray:
         """Run one prepared obs batch (leading dim ``n``) through the policy.
 
@@ -376,6 +453,14 @@ class InferencePolicy:
         chunks. For stateful policies, per-session state rows are gathered
         before and scattered after the step (``sessions[i] is None`` rows act
         from a fresh initial state and are not persisted).
+
+        ``expired_out`` (when given) collects the indices of sessions whose
+        state was LRU-evicted AFTER the caller's expiry check but BEFORE this
+        gather — the submit→gather race. Those rows run on a throwaway
+        initial state and are neither persisted nor safe to ack: the caller
+        must fail each one with :class:`SessionExpired` so the client
+        re-hydrates, instead of silently restarting the latent (and then
+        poisoning whatever trusts the returned state).
         """
         import jax
 
@@ -386,18 +471,29 @@ class InferencePolicy:
                 hi = min(n, lo + max_bucket)
                 chunk = jax.tree.map(lambda x: np.asarray(x)[lo:hi], obs)
                 sess = sessions[lo:hi] if sessions is not None else None
-                outs.append(self.act_batch(chunk, hi - lo, deterministic, sess))
+                sub_expired: Optional[List[int]] = [] if expired_out is not None else None
+                outs.append(self.act_batch(chunk, hi - lo, deterministic, sess, sub_expired))
+                if expired_out is not None and sub_expired:
+                    expired_out.extend(lo + i for i in sub_expired)
             return np.concatenate(outs, axis=0)
 
         bucket = _bucket_for(n, self.buckets)
         params, _ = self.current_params()
         state = None
         sess_list: List[Optional[str]] = list(sessions) if sessions is not None else []
+        expired_idx: set = set()
         if self.core.stateful:
             rows = []
             for i in range(n):
                 sid = sess_list[i] if i < len(sess_list) else None
                 row = self.sessions.get(sid) if sid is not None else None
+                if (
+                    row is None
+                    and sid is not None
+                    and expired_out is not None
+                    and self.sessions.expired(sid)
+                ):
+                    expired_idx.add(i)
                 rows.append(row if row is not None else self._init_row)
             rows.extend([self._init_row] * (bucket - n))
             state = self._stack_rows(rows)
@@ -412,8 +508,10 @@ class InferencePolicy:
             host_state = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), new_state)
             for i in range(n):
                 sid = sess_list[i] if i < len(sess_list) else None
-                if sid is not None:
+                if sid is not None and i not in expired_idx:
                     self.sessions.put(sid, jax.tree.map(lambda x: x[i : i + 1], host_state))
+        if expired_out is not None:
+            expired_out.extend(sorted(expired_idx))
         return actions_np
 
     def act(

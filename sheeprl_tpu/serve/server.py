@@ -13,14 +13,20 @@ two ways:
 
 Endpoints:
 
-    POST /v1/act      {"obs": {...}, "deterministic": bool, "session_id": str}
-                      -> {"actions": [[...]], "params_version": int}
-    GET  /healthz     liveness + params version
+    POST /v1/act      {"obs": {...}, "deterministic": bool, "session_id": str,
+                       "session_state": b64?, "return_state": bool?}
+                      -> {"actions": [[...]], "params_version": int,
+                          "session_state": b64?}
+    GET  /healthz     liveness + params version + reload staleness seconds
     GET  /stats       full serve telemetry snapshot (the `serve` JSONL record,
                       incl. p50/p95/p99 latency)
     GET  /metrics     Prometheus text format (latency + batch-occupancy
                       histograms backed by diag/prometheus.py's registry)
-    503 + Retry-After when the queue is saturated (Backpressure)
+    POST /admin/reload  force one checkpoint-reload poll (the gateway's
+                      rolling-drain hook)
+    410 session_expired when a live session's latent was LRU-evicted (the
+                      gateway re-hydrates it from the broker and retries)
+    503 + Retry-After (jittered) when the queue is saturated (Backpressure)
 
 `serve_from_checkpoint` is the CLI entrypoint's workhorse: checkpoint →
 policy (+warmup) → batcher → reloader → HTTP, with serve telemetry JSONL
@@ -36,12 +42,17 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from .batcher import Backpressure, MicroBatcher
-from .policy import InferencePolicy
+from .policy import InferencePolicy, SessionExpired
 from .reload import CheckpointReloader
+from .session_codec import StateDecodeError, decode_state, encode_state
 
 
 class PolicyServer:
-    """Owns the serving stack; start()/stop() manage all background threads."""
+    """Owns the serving stack; start()/stop() manage all background threads.
+
+    ``on_act`` is an optional hook invoked at the top of every HTTP act
+    request (after parsing, before batching) — the gateway's replica wrapper
+    uses it for chaos injection and synthetic latency."""
 
     def __init__(
         self,
@@ -51,6 +62,7 @@ class PolicyServer:
         host: str = "127.0.0.1",
         port: int = 0,
         http_enabled: bool = True,
+        on_act: Optional[Any] = None,
     ) -> None:
         self.policy = policy
         self.batcher = batcher
@@ -58,6 +70,7 @@ class PolicyServer:
         self.host = host
         self._requested_port = int(port)
         self.http_enabled = bool(http_enabled)
+        self.on_act = on_act
         self._httpd: Any = None
         self._http_thread: Optional[threading.Thread] = None
 
@@ -154,12 +167,16 @@ def _make_handler(server: "PolicyServer"):
 
         def do_GET(self) -> None:
             if self.path == "/healthz":
+                # liveness + freshness: param_version and reload staleness
+                # let a gateway's health-based routing prefer fresh replicas
                 self._reply(
                     200,
                     {
                         "status": "ok",
                         "params_version": server.policy.params_version,
                         "reloads": server.policy.reload_count,
+                        "reload_staleness_s": round(server.policy.params_staleness_s(), 3),
+                        "sessions": len(server.policy.sessions),
                     },
                 )
             elif self.path == "/stats":
@@ -177,6 +194,9 @@ def _make_handler(server: "PolicyServer"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self) -> None:
+            if self.path in ("/admin/reload",):
+                self._admin_reload()
+                return
             if self.path not in ("/v1/act", "/act"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
@@ -191,11 +211,33 @@ def _make_handler(server: "PolicyServer"):
                 obs = {k: np.asarray(v) for k, v in raw_obs.items()}
                 deterministic = bool(payload.get("deterministic", False))
                 session = payload.get("session_id")
+                # externalized-state protocol (gateway broker): an inbound
+                # blob re-hydrates the replica's session cache BEFORE the
+                # step — the broker's copy wins over whatever is cached
+                inbound_state = payload.get("session_state")
+                if inbound_state is not None:
+                    if session is None:
+                        raise ValueError("'session_state' requires a 'session_id'")
+                    server.policy.import_session(session, decode_state(inbound_state))
+                return_state = bool(payload.get("return_state", False))
+            except StateDecodeError as e:
+                self._reply(400, {"error": str(e)})
+                return
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 self._reply(400, {"error": str(e)})
                 return
+            if server.on_act is not None:
+                server.on_act()
             try:
                 actions = server.act(obs, deterministic=deterministic, session=session)
+            except SessionExpired as e:
+                # the latent was LRU-evicted: tell the caller (the gateway
+                # translates this into a broker re-hydrate + retry) instead
+                # of silently restarting the session from the initial state
+                self._reply(
+                    410, {"error": "session_expired", "session_id": e.session_id}
+                )
+                return
             except ValueError as e:  # malformed obs (shape/dtype/structure)
                 self._reply(400, {"error": str(e)})
                 return
@@ -212,12 +254,41 @@ def _make_handler(server: "PolicyServer"):
             except Exception as e:
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                 return
+            body: Dict[str, Any] = {
+                "actions": np.asarray(actions).tolist(),
+                "params_version": server.policy.params_version,
+            }
+            if return_state and session is not None:
+                row = server.policy.export_session(session)
+                if row is not None:
+                    body["session_state"] = encode_state(row)
+                elif getattr(getattr(server.policy, "core", None), "stateful", False):
+                    # the latent was LRU-evicted between the step's scatter
+                    # and this export: acking without the updated state
+                    # would leave the caller's copy behind the trajectory
+                    # it just acked — 410 makes it replay from its own copy
+                    self._reply(
+                        410, {"error": "session_expired", "session_id": session}
+                    )
+                    return
+            self._reply(200, body)
+
+        def _admin_reload(self) -> None:
+            """One rolling-drain step: force a checkpoint-reload poll NOW.
+            The gateway's ReplicaManager drives this one replica at a time so
+            a fleet-wide param swap never stages weights everywhere at once."""
+            if server.reloader is None:
+                self._reply(
+                    409, {"error": "no reloader attached", "params_version": server.policy.params_version}
+                )
+                return
+            try:
+                swapped = bool(server.reloader.poll_once())
+            except Exception as e:
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                return
             self._reply(
-                200,
-                {
-                    "actions": np.asarray(actions).tolist(),
-                    "params_version": server.policy.params_version,
-                },
+                200, {"swapped": swapped, "params_version": server.policy.params_version}
             )
 
     return Handler

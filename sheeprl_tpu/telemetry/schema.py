@@ -86,6 +86,8 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "completed": (False, _NUM),
         "rejected": (False, _NUM),
         "errors": (False, _NUM),
+        "evictions": (False, _NUM),
+        "expired": (False, _NUM),
         "batches": (False, _NUM),
         "queue_depth": (False, _NUM),
         "batch_occupancy": (False, _NUM),
@@ -218,6 +220,80 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "checkpoint": (False, _STR),
         "run_dir": (False, _STR),
         "fingerprint": (False, _STR),
+    },
+    # per-session lifecycle incidents on the serve stream (serve/batcher.py):
+    # `evicted` = a live session's latent fell off the LRU (the next request
+    # gets 410 unless re-hydrated)
+    "session": {
+        "action": (True, _STR),  # evicted
+        "session_id": (False, _STR),
+        "detail": (False, _STR),
+    },
+    # serving-replica supervision stream (sheeprl_tpu/gateway/replica.py):
+    # spawn | respawn | ready (port bound) | crash | hang | quarantine |
+    # drain | reload — the serving analogue of the `fleet` incident events
+    "replica": {
+        "action": (True, _STR),
+        "replica": (False, _NUM),
+        "incarnation": (False, _NUM),
+        "pid": (False, _NUM),
+        "port": (False, _NUM),
+        "fails_in_window": (False, _NUM),
+        "params_version": (False, _NUM),
+        "detail": (False, _STR),
+    },
+    # gateway stat snapshot (sheeprl_tpu/gateway/gateway.py): request/ack/
+    # shed/failover counters, end-to-end latency percentiles, fleet liveness
+    # and admission-controller occupancy — the multi-replica analogue of the
+    # `serve` record
+    "gateway": {
+        "requests": (True, _NUM),
+        "acked": (False, _NUM),
+        "errors": (False, _NUM),
+        "failovers": (False, _NUM),
+        "migrations": (False, _NUM),
+        "rehydrates": (False, _NUM),
+        "expired": (False, _NUM),
+        "lost": (False, _NUM),
+        "retries": (False, _NUM),
+        "p50_ms": (False, _NUM),
+        "p95_ms": (False, _NUM),
+        "p99_ms": (False, _NUM),
+        "replicas": (False, _NUM),
+        "routable": (False, _NUM),
+        "quarantined": (False, _NUM),
+        "respawns": (False, _NUM),
+        "sessions": (False, _NUM),
+        "broker_sessions": (False, _NUM),
+        "admission_inflight": (False, _NUM),
+        "admission_admitted": (False, _NUM),
+        "admission_shed": (False, _NUM),
+        "admission_shed_low": (False, _NUM),
+        "admission_tokens": (False, _NUM),
+    },
+    # serving load-bench record (scripts/bench_serve.py -> SERVE_r*.json):
+    # latency percentiles + shed rate + failover recovery, gated run-over-run
+    # by scripts/bench_compare.py with lower-is-better direction
+    "serve_bench": {
+        "metric": (True, _STR),
+        "value": (True, _NUM),
+        "unit": (True, _STR),
+        "vs_baseline": (True, _NUM),
+        "direction": (False, _STR),  # lower | higher (gate direction)
+        "p50_ms": (True, _NUM),
+        "p95_ms": (True, _NUM),
+        "p99_ms": (True, _NUM),
+        "shed_rate": (True, _NUM),
+        "error_rate": (False, _NUM),
+        "requests": (False, _NUM),
+        "acked": (False, _NUM),
+        "throughput_rps": (False, _NUM),
+        "sessions": (False, _NUM),
+        "replicas": (False, _NUM),
+        "concurrency": (False, _NUM),
+        "duration_s": (False, _NUM),
+        "failover": (False, _DICT),  # {killed_replica, recovery_s, acked_loss}
+        "platform": (False, _STR),
     },
 }
 

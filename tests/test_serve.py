@@ -275,7 +275,7 @@ class _BlockingPolicy:
     def prepare(self, raw, n):
         return {"x": np.zeros((n, 1), np.float32)}
 
-    def act_batch(self, obs, n, deterministic=False, sessions=None):
+    def act_batch(self, obs, n, deterministic=False, sessions=None, expired_out=None):
         self.entered.set()
         assert self.release.wait(30.0)
         return np.zeros((n, 1), np.float32)
@@ -323,7 +323,7 @@ def test_batcher_groups_by_deterministic_flag():
             super().__init__()
             self.release.set()
 
-        def act_batch(self, obs, n, deterministic=False, sessions=None):
+        def act_batch(self, obs, n, deterministic=False, sessions=None, expired_out=None):
             calls.append((n, deterministic))
             return np.zeros((n, 1), np.float32)
 
@@ -347,7 +347,7 @@ def test_batcher_groups_by_deterministic_flag():
 
 def test_batcher_propagates_policy_error_to_caller():
     class _FailingPolicy(_BlockingPolicy):
-        def act_batch(self, obs, n, deterministic=False, sessions=None):
+        def act_batch(self, obs, n, deterministic=False, sessions=None, expired_out=None):
             raise ValueError("bad obs shape")
 
     batcher = MicroBatcher(_FailingPolicy(), max_wait_ms=0.0).start()
