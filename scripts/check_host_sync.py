@@ -1,191 +1,62 @@
 #!/usr/bin/env python
 """Static check: no hidden host syncs in the training hot loops.
 
-Every device→host materialization inside a per-step loop (``.item()``,
-``float(<jax.Array>)``, ``np.asarray(metrics)``) blocks the async dispatch
-pipeline: the host waits for the device instead of racing ahead, and on a
-remote-accelerator link each sync costs a full round trip. The loops were
-purged of these (metrics are held as device refs until the log-cadence
-flush); this AST check keeps them purged — it fails on NEW syncs.
+COMPAT SHIM — the implementation moved to
+``sheeprl_tpu/analysis/rules/host_sync.py`` when the one-off script grew into
+the pluggable rule engine behind ``sheeprl_tpu lint`` (rule id
+``host-sync``). This entry point keeps the original contract for existing
+docs, CI and tests/test_host_sync_check.py:
 
-Scope (deliberately narrow, to stay precise):
+* ``check_file(path)`` / ``check_paths(paths)`` return
+  ``List[(path, lineno, message)]``;
+* CLI: ``python scripts/check_host_sync.py [paths...]`` scans
+  ``sheeprl_tpu/{algos,fleet,gateway}`` by default, prints
+  ``path:lineno: message`` lines to stderr and exits 1 on violations;
+* the ``# host-sync: ok`` line comment stays an exemption.
 
-* functions decorated with ``@register_algorithm`` (the train loops) and
-  functions whose name ends with ``_loop`` (decoupled player loops, the
-  fleet worker loop) in the given files/dirs (default:
-  ``sheeprl_tpu/algos`` + ``sheeprl_tpu/fleet`` — the worker step path must
-  stay host-sync clean too: a hidden sync there stalls every env slice the
-  worker owns — + ``sheeprl_tpu/gateway``, whose supervision/serving loops
-  must never block on a device either);
-* only statements inside a ``while``/``for`` loop in those functions — the
-  hot path, not setup code.
-
-Flagged patterns:
-
-* ``<expr>.item()`` — always a device sync on a jax.Array;
-* ``float(<expr>)`` — unless the argument is a constant or rooted at a
-  known host-side name (``cfg``, ``os``, ``time``, ``np``, …);
-* ``np.asarray``/``jnp.asarray``/``np.array`` over ``metrics`` (directly,
-  or over the loop variable of ``for ... in metrics.items()``) — the
-  classic per-step metrics materialization.
-
-Allowlist: a statement inside an ``if`` gated on the log cadence
-(``last_log`` / ``log_every`` / ``dry_run`` in the test) is exempt — that
-flush is the one place the syncs belong — and so is any line carrying a
-``# host-sync: ok`` comment (state the cadence in the comment).
-
-Usage: ``python scripts/check_host_sync.py [paths...]``; exits 1 on
-violations. Wired into tier-1 via tests/test_host_sync_check.py.
+Prefer ``sheeprl_tpu lint --rule host-sync`` (or the full rule set) for new
+tooling — it adds `# lint: ok[...]` suppressions, ``--json`` findings with
+stable rule ids, and the five sibling rules.
 """
 from __future__ import annotations
 
-import ast
+import os
 import sys
 from pathlib import Path
-from typing import List, Optional, Set, Tuple
+from typing import List
 
-# names whose float() is host-side arithmetic, not a device sync
-ALLOWED_FLOAT_ROOTS = {
-    "cfg", "wm_cfg", "moments_cfg", "os", "np", "math", "time", "sys",
-    "int", "float", "len", "state", "world_size", "deadline",
-}
-ASARRAY_FUNCS = {("np", "asarray"), ("jnp", "asarray"), ("np", "array"), ("jnp", "array")}
-ALLOW_COMMENT = "# host-sync: ok"
-CADENCE_NAMES = {"last_log", "log_every", "dry_run", "last_checkpoint"}
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # direct script invocation without an install
+    sys.path.insert(0, str(_REPO))
 
-
-def _root_name(node: ast.AST) -> Optional[str]:
-    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
-        node = node.func if isinstance(node, ast.Call) else node.value
-    return node.id if isinstance(node, ast.Name) else None
-
-
-def _is_algo_entrypoint(fn: ast.FunctionDef) -> bool:
-    for dec in fn.decorator_list:
-        target = dec.func if isinstance(dec, ast.Call) else dec
-        name = target.attr if isinstance(target, ast.Attribute) else getattr(target, "id", "")
-        if name == "register_algorithm":
-            return True
-    return fn.name.endswith("_loop")
-
-
-def _names_in(node: ast.AST) -> Set[str]:
-    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)} | {
-        n.attr for n in ast.walk(node) if isinstance(n, ast.Attribute)
-    }
-
-
-class _HotLoopChecker(ast.NodeVisitor):
-    def __init__(self, path: Path, source_lines: List[str]):
-        self.path = path
-        self.lines = source_lines
-        self.violations: List[Tuple[Path, int, str]] = []
-        self._loop_depth = 0
-        self._cadence_depth = 0  # inside a log/ckpt-cadence `if`
-        self._metrics_aliases: Set[str] = {"metrics"}
-
-    # -- scope plumbing ----------------------------------------------------
-    def visit_loop(self, node: ast.AST) -> None:
-        self._loop_depth += 1
-        self.generic_visit(node)
-        self._loop_depth -= 1
-
-    visit_While = visit_loop
-    visit_For = visit_loop
-
-    def visit_If(self, node: ast.If) -> None:
-        cadence = bool(_names_in(node.test) & CADENCE_NAMES)
-        if cadence:
-            self._cadence_depth += 1
-        self.generic_visit(node)
-        if cadence:
-            self._cadence_depth -= 1
-
-    def _track_metrics_alias(self, node: ast.For) -> None:
-        """`for k, v in metrics.items():` makes `v` a metrics alias."""
-        it = node.iter
-        if (
-            isinstance(it, ast.Call)
-            and isinstance(it.func, ast.Attribute)
-            and it.func.attr == "items"
-            and _root_name(it.func.value) in self._metrics_aliases
-        ):
-            for t in ast.walk(node.target):
-                if isinstance(t, ast.Name):
-                    self._metrics_aliases.add(t.id)
-
-    # -- the checks --------------------------------------------------------
-    def _allowed_line(self, lineno: int) -> bool:
-        line = self.lines[lineno - 1] if lineno - 1 < len(self.lines) else ""
-        return ALLOW_COMMENT in line
-
-    def _flag(self, node: ast.AST, msg: str) -> None:
-        if self._loop_depth == 0 or self._cadence_depth > 0:
-            return
-        if self._allowed_line(node.lineno):
-            return
-        self.violations.append((self.path, node.lineno, msg))
-
-    def visit_Call(self, node: ast.Call) -> None:
-        fn = node.func
-        # <expr>.item()
-        if isinstance(fn, ast.Attribute) and fn.attr == "item" and not node.args:
-            self._flag(node, ".item() host sync in a hot loop")
-        # float(<device expr>)
-        if isinstance(fn, ast.Name) and fn.id == "float" and node.args:
-            arg = node.args[0]
-            if not isinstance(arg, ast.Constant) and _root_name(arg) not in ALLOWED_FLOAT_ROOTS:
-                self._flag(node, f"float({ast.unparse(arg)}) host sync in a hot loop")
-        # np.asarray(metrics) / np.asarray(v) with v from metrics.items()
-        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
-            if (fn.value.id, fn.attr) in ASARRAY_FUNCS and node.args:
-                root = _root_name(node.args[0])
-                if root in self._metrics_aliases:
-                    self._flag(
-                        node,
-                        f"{fn.value.id}.{fn.attr}({ast.unparse(node.args[0])}) materializes "
-                        "train metrics per step (defer to the log-cadence flush)",
-                    )
-        self.generic_visit(node)
-
-    def visit_For(self, node: ast.For) -> None:  # noqa: N802 — ast API
-        self._track_metrics_alias(node)
-        self.visit_loop(node)
-
-
-def check_file(path: Path) -> List[Tuple[Path, int, str]]:
-    source = path.read_text()
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as err:
-        return [(path, err.lineno or 0, f"syntax error: {err.msg}")]
-    lines = source.splitlines()
-    out: List[Tuple[Path, int, str]] = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and _is_algo_entrypoint(node):
-            checker = _HotLoopChecker(path, lines)
-            for stmt in node.body:
-                checker.visit(stmt)
-            out.extend(checker.violations)
-    return out
-
-
-def check_paths(paths: List[Path]) -> List[Tuple[Path, int, str]]:
-    files: List[Path] = []
-    for p in paths:
-        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
-    out: List[Tuple[Path, int, str]] = []
-    for f in files:
-        out.extend(check_file(f))
-    return out
+# the host-sync rule is stdlib-only AST work: skip the parent package's
+# algo-registry (and therefore jax) import when this process hasn't already
+# paid it — keeps the shim's sub-second startup. The variable is set ONLY
+# for the duration of this import and then removed: leaving it in
+# os.environ would empty the algorithm registry for any later
+# `import sheeprl_tpu` in this process and for every spawned child.
+_light = "sheeprl_tpu" not in sys.modules and "SHEEPRL_TPU_LINT_LIGHT" not in os.environ
+if _light:
+    os.environ["SHEEPRL_TPU_LINT_LIGHT"] = "1"
+try:
+    from sheeprl_tpu.analysis.rules.host_sync import (  # noqa: E402,F401 — re-exported API
+        ALLOW_COMMENT,
+        ALLOWED_FLOAT_ROOTS,
+        ASARRAY_FUNCS,
+        CADENCE_NAMES,
+        check_file,
+        check_paths,
+    )
+finally:
+    if _light:
+        del os.environ["SHEEPRL_TPU_LINT_LIGHT"]
 
 
 def main(argv: List[str]) -> int:
-    repo = Path(__file__).resolve().parent.parent
     paths = [Path(a) for a in argv] or [
-        repo / "sheeprl_tpu" / "algos",
-        repo / "sheeprl_tpu" / "fleet",
-        repo / "sheeprl_tpu" / "gateway",
+        _REPO / "sheeprl_tpu" / "algos",
+        _REPO / "sheeprl_tpu" / "fleet",
+        _REPO / "sheeprl_tpu" / "gateway",
     ]
     violations = check_paths(paths)
     for path, lineno, msg in violations:

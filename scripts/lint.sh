@@ -1,10 +1,13 @@
 #!/usr/bin/env bash
 # Single lint/gate entry point, wired into tier-1 (tests/test_lint.py) so
-# neither check can silently rot:
-#   * scripts/check_host_sync.py — the AST lint against hidden device→host
-#     syncs in the training hot loops (sheeprl_tpu/algos), the fleet worker
-#     step path (sheeprl_tpu/fleet) AND the serving-gateway loops
-#     (sheeprl_tpu/gateway) — its default scan set;
+# none of the checks can silently rot:
+#   * `sheeprl_tpu lint` — the JAX-aware static-analysis pass
+#     (sheeprl_tpu/analysis/): host-sync, retrace-hazard, rng-reuse,
+#     use-after-donate, thread-shared-state and telemetry-schema-drift rules
+#     over the whole package; exits 1 on any unsuppressed finding
+#     (suppression syntax + rule catalogue: howto/static_analysis.md);
+#   * scripts/check_host_sync.py — the compat shim over the host-sync rule,
+#     kept in the gate so the shim's CLI/exit-code contract stays exercised;
 #   * scripts/bench_compare.py --dry-run — the bench regression gate run
 #     over the repo's recorded BENCH_*/MULTICHIP_*/SERVE_* trajectory (full
 #     comparison + report; --dry-run keeps a slower CI host from failing
@@ -14,5 +17,8 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# SHEEPRL_TPU_LINT_LIGHT skips the package's algo-registry (jax) import —
+# the analysis pass is stdlib-only AST work
+SHEEPRL_TPU_LINT_LIGHT=1 python -m sheeprl_tpu.analysis sheeprl_tpu
 python scripts/check_host_sync.py
 python scripts/bench_compare.py --dry-run

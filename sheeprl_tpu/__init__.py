@@ -15,20 +15,26 @@ import os
 
 __version__ = "0.1.0"
 
-# Algorithm modules register themselves on import.
-from sheeprl_tpu.algos import (  # noqa: F401,E402
-    a2c,
-    dreamer_v1,
-    dreamer_v2,
-    dreamer_v3,
-    droq,
-    p2e_dv1,
-    p2e_dv2,
-    p2e_dv3,
-    ppo,
-    ppo_recurrent,
-    sac,
-    sac_ae,
-)
+# Algorithm modules register themselves on import. The lint entry points
+# (scripts/check_host_sync.py, `SHEEPRL_TPU_LINT_LIGHT=1 python -m
+# sheeprl_tpu.analysis` in scripts/lint.sh) skip this: the analysis package
+# is stdlib-only AST work and must not pay the jax import (~4s) twice per
+# lint. Anything that needs the registry (run/eval/serve/...) leaves the
+# variable unset.
+if not os.environ.get("SHEEPRL_TPU_LINT_LIGHT"):
+    from sheeprl_tpu.algos import (  # noqa: F401,E402
+        a2c,
+        dreamer_v1,
+        dreamer_v2,
+        dreamer_v3,
+        droq,
+        p2e_dv1,
+        p2e_dv2,
+        p2e_dv3,
+        ppo,
+        ppo_recurrent,
+        sac,
+        sac_ae,
+    )
 
 __all__ = ["__version__"]

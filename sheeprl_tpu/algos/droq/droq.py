@@ -91,13 +91,16 @@ def make_train_fn(actor, critic, txs, cfg: Config, target_entropy: float):
         # --- single actor update on its own batch, MEAN of Q -------------
         def actor_loss_fn(ap):
             m, ls = actor.apply({"params": ap}, actor_batch["observations"])
-            acts, logp = sample_actions(actor, m, ls, actor_key)
+            # one split, two independent streams: sampling the actions and
+            # the critic's dropout masks must not share actor_key
+            k_sample, k_drop = jax.random.split(actor_key)
+            acts, logp = sample_actions(actor, m, ls, k_sample)
             q = critic.apply(
                 {"params": params["critic"]},
                 actor_batch["observations"],
                 acts,
                 deterministic=False,
-                rngs={"dropout": jax.random.fold_in(actor_key, 7)},
+                rngs={"dropout": k_drop},
             )
             mean_q = jnp.mean(q, axis=0)
             return policy_loss(jnp.exp(params["log_alpha"]), logp, mean_q), logp

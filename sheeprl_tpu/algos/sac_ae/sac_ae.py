@@ -134,8 +134,11 @@ def make_train_fn(encoder, decoder, qs, actor, txs, cfg: Config, target_entropy:
             hidden = encoder.apply({"params": enc_p}, obs)
             rec = decoder.apply({"params": dec_p}, hidden)
             loss = 0.0
-            for k in cnn_keys:
-                target = preprocess_obs(batch[k], bits=5, key=jax.random.fold_in(key, 2))
+            for i, k in enumerate(cnn_keys):
+                # distinct derived key per obs key: fold_in(key, 2) for all of
+                # them would quantization-dither every camera with the SAME
+                # noise pattern (and trip the rng-reuse lint's loop check)
+                target = preprocess_obs(batch[k], bits=5, key=jax.random.fold_in(key, 2 + i))
                 loss += jnp.mean(jnp.square(target - rec[k]))
                 loss += l2_lambda * jnp.mean(0.5 * jnp.sum(jnp.square(hidden), axis=-1))
             for k in mlp_keys:

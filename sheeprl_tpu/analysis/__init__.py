@@ -1,0 +1,22 @@
+"""JAX-aware static analysis for sheeprl_tpu (`sheeprl_tpu lint`).
+
+A pluggable AST rule engine (:mod:`.engine`) plus the rule catalogue
+(:mod:`.rules`): host-sync, retrace-hazard, rng-reuse, use-after-donate,
+thread-shared-state, telemetry-schema-drift. See howto/static_analysis.md
+for the catalogue, suppression syntax and how to add a rule.
+"""
+from __future__ import annotations
+
+from .engine import Finding, ModuleContext, Rule, check_file, main, run_paths
+from .rules import RULE_CLASSES, all_rules
+
+__all__ = [
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "RULE_CLASSES",
+    "all_rules",
+    "check_file",
+    "main",
+    "run_paths",
+]

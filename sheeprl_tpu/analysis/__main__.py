@@ -1,0 +1,8 @@
+"""`python -m sheeprl_tpu.analysis [paths...] [--json] [--rule r1,r2]` — the
+same pass `sheeprl_tpu lint` runs, importable without the CLI dispatcher."""
+import sys
+
+from .engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,151 @@
+"""Shared linear (source-order) per-function data-flow walk.
+
+The rng-reuse and use-after-donate rules are the same machine with
+different state: walk one function's statements in order, process each
+statement's expressions (check uses, record consumptions/donations), track
+stores, and handle control flow conservatively —
+
+* ``if``/``else`` branches are exclusive: each walks from the pre-``if``
+  state, and only branches that don't terminate (``return``/``raise``/
+  ``break``/``continue``) merge into the fall-through state;
+* loop bodies push their store-set on ``loop_stores`` so rules can detect
+  back-edge reuse (state consumed in a loop whose body never rebinds it);
+* comprehension targets live in their own scope and are exposed via
+  :func:`comprehension_targets` so they aren't mistaken for outer names;
+* nested ``def``/``class`` are skipped — nested scopes get their own walk.
+
+Subclasses declare their per-name state containers in ``STATE_ATTRS``
+(each a ``dict`` or ``set`` attribute); snapshot/branch-merge over them is
+generic. They implement ``on_expr`` (uses + consumptions), ``on_store``
+(rebinding) and optionally ``on_delete``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+
+def store_names(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)}
+
+
+def terminates(body: List[ast.stmt]) -> bool:
+    return bool(body) and isinstance(body[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def comprehension_targets(expr: ast.AST) -> Set[str]:
+    """Names bound by comprehension generators inside ``expr`` — they live in
+    the comprehension's own scope and must not be mistaken for outer names."""
+    out: Set[str] = set()
+    for n in ast.walk(expr):
+        if isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            for gen in n.generators:
+                out |= store_names(gen.target)
+    return out
+
+
+class LinearWalker:
+    STATE_ATTRS: Tuple[str, ...] = ()
+
+    def __init__(self) -> None:
+        # stack of loop-body store sets, for back-edge checks
+        self.loop_stores: List[Set[str]] = []
+
+    # -- hooks (subclass) --------------------------------------------------
+    def on_expr(self, expr: ast.AST) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def on_store(self, target: ast.AST, value: Optional[ast.AST]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def on_delete(self, name: str) -> None:
+        pass
+
+    # -- state snapshot / branch merge over STATE_ATTRS --------------------
+    def _snapshot(self) -> Tuple:
+        return tuple(
+            dict(v) if isinstance(v := getattr(self, a), dict) else set(v)
+            for a in self.STATE_ATTRS
+        )
+
+    def _restore(self, snap: Tuple) -> None:
+        for a, v in zip(self.STATE_ATTRS, snap):
+            setattr(self, a, dict(v) if isinstance(v, dict) else set(v))
+
+    def _merge_live(self, snaps: List[Tuple], before: Tuple) -> None:
+        if not snaps:
+            self._restore(before)
+            return
+        for i, a in enumerate(self.STATE_ATTRS):
+            if isinstance(snaps[0][i], dict):
+                merged: object = {}
+                for s in snaps:
+                    merged.update(s[i])  # type: ignore[union-attr]
+            else:
+                merged = set().union(*(s[i] for s in snaps))
+            setattr(self, a, merged)
+
+    # -- the walk ----------------------------------------------------------
+    def walk_body(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self.walk_stmt(stmt)
+
+    def walk_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested scopes get their own walker
+        if isinstance(stmt, ast.Assign):
+            self.on_expr(stmt.value)
+            for t in stmt.targets:
+                self.on_store(t, stmt.value)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if getattr(stmt, "value", None) is not None:
+                self.on_expr(stmt.value)
+            self.on_store(stmt.target, getattr(stmt, "value", None))
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.on_expr(stmt.iter)
+            self.loop_stores.append(store_names(stmt))
+            self.on_store(stmt.target, None)
+            self.walk_body(stmt.body)
+            self.loop_stores.pop()
+            self.walk_body(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self.loop_stores.append(store_names(stmt))
+            self.on_expr(stmt.test)
+            self.walk_body(stmt.body)
+            self.loop_stores.pop()
+            self.walk_body(stmt.orelse)
+        elif isinstance(stmt, ast.If):
+            self.on_expr(stmt.test)
+            before = self._snapshot()
+            self.walk_body(stmt.body)
+            body_snap = self._snapshot()
+            body_live = not terminates(stmt.body)
+            self._restore(before)
+            self.walk_body(stmt.orelse)
+            else_snap = self._snapshot()
+            else_live = not (stmt.orelse and terminates(stmt.orelse))
+            live = [s for s, ok in ((else_snap, else_live), (body_snap, body_live)) if ok]
+            self._merge_live(live, before)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.on_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self.on_store(item.optional_vars, None)
+            self.walk_body(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self.walk_body(stmt.body)
+            for handler in stmt.handlers:
+                self.walk_body(handler.body)
+            self.walk_body(stmt.orelse)
+            self.walk_body(stmt.finalbody)
+        elif isinstance(stmt, (ast.Expr, ast.Return)):
+            if stmt.value is not None:
+                self.on_expr(stmt.value)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    self.on_delete(t.id)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self.on_expr(child)

@@ -1,0 +1,179 @@
+"""Shared discovery of jitted callables in a module.
+
+Both the retrace-hazard and use-after-donate rules need the same facts: which
+local names are jit-compiled functions, and what their ``static_argnums`` /
+``static_argnames`` / ``donate_argnums`` / ``donate_argnames`` are. Covered
+binding forms (the ones this codebase uses):
+
+* ``@jax.jit`` / ``@jit`` decorated defs;
+* ``@partial(jax.jit, static_argnames=..., donate_argnums=...)`` (also via
+  ``functools.partial``) decorated defs;
+* ``name = jax.jit(fn, ...)`` assignments;
+* ``name = partial(jax.jit, ...)(fn_or_lambda)`` assignments.
+
+Call-site resolution is by bound name within the module (including
+``self.<name>`` attribute calls when the attribute name matches), which is
+precise enough for the closure-style jits the train loops use.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from .engine import ModuleContext
+
+PARTIAL_DOTTED = {"functools.partial", "partial"}
+
+
+@dataclass
+class JitSite:
+    name: str
+    lineno: int
+    params: List[str] = field(default_factory=list)
+    static_argnums: Set[int] = field(default_factory=set)
+    static_argnames: Set[str] = field(default_factory=set)
+    donate_argnums: Set[int] = field(default_factory=set)
+    donate_argnames: Set[str] = field(default_factory=set)
+
+    def static_positions(self) -> Set[int]:
+        pos = set(self.static_argnums)
+        for name in self.static_argnames:
+            if name in self.params:
+                pos.add(self.params.index(name))
+        return pos
+
+    def donated_positions(self) -> Set[int]:
+        pos = set(self.donate_argnums)
+        for name in self.donate_argnames:
+            if name in self.params:
+                pos.add(self.params.index(name))
+        return pos
+
+
+def _const_strings(node: ast.AST) -> Set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out: Set[str] = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+        return out
+    return set()
+
+
+def _const_ints(node: ast.AST) -> Set[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out: Set[int] = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.add(elt.value)
+        return out
+    return set()
+
+
+def _apply_kwargs(site: JitSite, keywords: List[ast.keyword]) -> None:
+    for kw in keywords:
+        if kw.arg == "static_argnums":
+            site.static_argnums |= _const_ints(kw.value)
+        elif kw.arg == "static_argnames":
+            site.static_argnames |= _const_strings(kw.value)
+        elif kw.arg == "donate_argnums":
+            site.donate_argnums |= _const_ints(kw.value)
+        elif kw.arg == "donate_argnames":
+            site.donate_argnames |= _const_strings(kw.value)
+
+
+def _is_jit(ctx: ModuleContext, node: ast.AST) -> bool:
+    return ctx.dotted(node) in {"jax.jit", "jax.api.jit"}
+
+
+def _partial_of_jit(ctx: ModuleContext, call: ast.Call) -> bool:
+    """``partial(jax.jit, **kw)``"""
+    return (
+        ctx.call_dotted(call) in PARTIAL_DOTTED
+        and bool(call.args)
+        and _is_jit(ctx, call.args[0])
+    )
+
+
+def _fn_params(fn: ast.AST) -> List[str]:
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = fn.args
+        return [p.arg for p in a.posonlyargs + a.args]
+    return []
+
+
+def collect_jit_sites(ctx: ModuleContext) -> Dict[str, JitSite]:
+    """Memoized on the context: both the retrace and donation rules need the
+    same map for the same module."""
+    cached = ctx.cache.get("jit_sites")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    sites = _collect_jit_sites(ctx)
+    ctx.cache["jit_sites"] = sites
+    return sites
+
+
+def _collect_jit_sites(ctx: ModuleContext) -> Dict[str, JitSite]:
+    sites: Dict[str, JitSite] = {}
+    defs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            defs[node.name] = node
+
+    for node in ast.walk(ctx.tree):
+        # decorated defs
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                site: Optional[JitSite] = None
+                if _is_jit(ctx, dec):
+                    site = JitSite(node.name, node.lineno, _fn_params(node))
+                elif isinstance(dec, ast.Call):
+                    if _is_jit(ctx, dec.func):
+                        site = JitSite(node.name, node.lineno, _fn_params(node))
+                        _apply_kwargs(site, dec.keywords)
+                    elif _partial_of_jit(ctx, dec):
+                        site = JitSite(node.name, node.lineno, _fn_params(node))
+                        _apply_kwargs(site, dec.keywords)
+                if site is not None:
+                    sites[site.name] = site
+        # assignments
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if not isinstance(target, ast.Name) or not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            site = None
+            if _is_jit(ctx, call.func):  # name = jax.jit(fn, ...)
+                inner = call.args[0] if call.args else None
+                params = _fn_params(inner) if isinstance(inner, ast.Lambda) else []
+                if isinstance(inner, ast.Name) and inner.id in defs:
+                    params = _fn_params(defs[inner.id])
+                site = JitSite(target.id, node.lineno, params)
+                _apply_kwargs(site, call.keywords)
+            elif isinstance(call.func, ast.Call) and _partial_of_jit(ctx, call.func):
+                # name = partial(jax.jit, ...)(fn_or_lambda)
+                inner = call.args[0] if call.args else None
+                params = _fn_params(inner) if isinstance(inner, ast.Lambda) else []
+                if isinstance(inner, ast.Name) and inner.id in defs:
+                    params = _fn_params(defs[inner.id])
+                site = JitSite(target.id, node.lineno, params)
+                _apply_kwargs(site, call.func.keywords)
+            if site is not None:
+                sites[site.name] = site
+    return sites
+
+
+def callee_site(sites: Dict[str, JitSite], call: ast.Call) -> Optional[JitSite]:
+    """Resolve a call to a known jit site by bound name (``f(...)`` or
+    ``self.f(...)``)."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return sites.get(fn.id)
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) and fn.value.id == "self":
+        return sites.get(fn.attr)
+    return None

@@ -1,0 +1,28 @@
+"""The rule catalogue. ``all_rules()`` builds one fresh instance of every
+registered rule — order is the order findings are attributed in, and the
+``rule_id`` strings here are STABLE: ``--json`` consumers (doctor folding,
+CI annotations) key on them."""
+from __future__ import annotations
+
+from typing import List
+
+from ..engine import Rule
+from .donation import UseAfterDonateRule
+from .host_sync import HostSyncRule
+from .retrace import RetraceHazardRule
+from .rng import RngReuseRule
+from .telemetry_schema import TelemetrySchemaRule
+from .threads import ThreadSharedStateRule
+
+RULE_CLASSES = [
+    HostSyncRule,
+    RetraceHazardRule,
+    RngReuseRule,
+    UseAfterDonateRule,
+    ThreadSharedStateRule,
+    TelemetrySchemaRule,
+]
+
+
+def all_rules() -> List[Rule]:
+    return [cls() for cls in RULE_CLASSES]

@@ -1,0 +1,116 @@
+"""use-after-donate: a buffer passed in a donated position is dead.
+
+``donate_argnums``/``donate_argnames`` tells XLA it may alias the input's
+device memory into the outputs — reading the Python name afterwards touches
+a deleted buffer and raises (on TPU) or silently reads garbage (on some
+backends/older runtimes). The sanctioned shape rebinds in the same
+statement: ``params, opt_state = train_step(params, opt_state, batch)``.
+
+The rule resolves module-local jitted callables (:mod:`..jitsites`), maps
+each call site's donated positions (argnums by call-site position, argnames
+through the jitted def's parameter list), and then, per function (shared
+control-flow semantics in :mod:`..dataflow`):
+
+* any load of a donated bare name after the donating call, before a
+  rebinding, is a finding;
+* a name donated **inside a loop** whose body never rebinds it is donated
+  again on the next iteration — the call itself is the read-after-donate
+  (dreamer's scanned train steps re-stage the replay batch per call for
+  exactly this reason).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Tuple
+
+from ..dataflow import LinearWalker, comprehension_targets, store_names
+from ..engine import Finding, ModuleContext, Rule
+from ..jitsites import JitSite, callee_site, collect_jit_sites
+
+
+class _FnWalker(LinearWalker):
+    STATE_ATTRS = ("donated",)
+
+    def __init__(self, rule: "UseAfterDonateRule", ctx: ModuleContext, sites: Dict[str, JitSite]):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        self.sites = sites
+        self.findings: List[Finding] = []
+        self.donated: Dict[str, Tuple[int, str]] = {}  # name -> (line, callee)
+
+    # -- hooks -------------------------------------------------------------
+    def on_expr(self, expr: ast.AST) -> None:
+        self._check_uses(expr)
+        self._donations(expr)
+
+    def on_store(self, target: ast.AST, value) -> None:
+        for name in store_names(target):
+            self.donated.pop(name, None)
+
+    def on_delete(self, name: str) -> None:
+        self.donated.pop(name, None)
+
+    # -- the checks --------------------------------------------------------
+    def _check_uses(self, expr: ast.AST) -> None:
+        shadowed = comprehension_targets(expr)
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in shadowed:
+                continue  # comprehension variable: its own scope
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) and n.id in self.donated:
+                line, callee = self.donated.pop(n.id)
+                self.findings.append(
+                    Finding(
+                        self.rule.rule_id,
+                        str(self.ctx.path),
+                        n.lineno,
+                        f"`{n.id}` read after being donated to jitted `{callee}` at line {line} — "
+                        "the device buffer was handed to XLA and is deleted",
+                        remediation="rebind the name from the call's outputs, or drop it from donate_argnums",
+                    )
+                )
+
+    def _donations(self, expr: ast.AST) -> None:
+        for call in ast.walk(expr):
+            if not isinstance(call, ast.Call):
+                continue
+            site = callee_site(self.sites, call)
+            if site is None:
+                continue
+            donated_pos = site.donated_positions()
+            names: List[Tuple[str, int]] = []
+            for i, arg in enumerate(call.args):
+                if i in donated_pos and isinstance(arg, ast.Name):
+                    names.append((arg.id, arg.lineno))
+            for kw in call.keywords:
+                if kw.arg in site.donate_argnames and isinstance(kw.value, ast.Name):
+                    names.append((kw.value.id, kw.value.lineno))
+            for name, line in names:
+                self.donated[name] = (line, site.name)
+                if self.loop_stores and not any(name in s for s in self.loop_stores):
+                    self.findings.append(
+                        Finding(
+                            self.rule.rule_id,
+                            str(self.ctx.path),
+                            line,
+                            f"`{name}` donated to jitted `{site.name}` inside a loop without "
+                            "rebinding — next iteration donates an already-deleted buffer",
+                            remediation="rebind the name each iteration (re-stage the batch per call)",
+                        )
+                    )
+
+
+class UseAfterDonateRule(Rule):
+    """Name read after being passed in a donate_argnums/donate_argnames position."""
+
+    rule_id = "use-after-donate"
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        sites = collect_jit_sites(ctx)
+        if not any(s.donate_argnums or s.donate_argnames for s in sites.values()):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                walker = _FnWalker(self, ctx, sites)
+                walker.walk_body(node.body)
+                yield from walker.findings

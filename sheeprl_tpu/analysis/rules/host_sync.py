@@ -1,0 +1,190 @@
+"""host-sync: no hidden device→host materializations in the training hot loops.
+
+Every ``.item()`` / ``float(<jax.Array>)`` / ``np.asarray(metrics)`` inside a
+per-step loop blocks the async dispatch pipeline: the host waits for the
+device instead of racing ahead, and on a remote-accelerator link each sync
+costs a full round trip. The loops hold metrics as device refs until the
+log-cadence flush; this rule keeps them that way — it fails on NEW syncs.
+
+Scope (deliberately narrow, to stay precise): statements inside a
+``while``/``for`` loop of a function decorated with ``@register_algorithm``
+or named ``*_loop`` (decoupled player loops, the fleet worker loop).
+
+Exemptions: statements under an ``if`` gated on the log cadence
+(``last_log`` / ``log_every`` / ``dry_run`` / ``last_checkpoint``), lines
+carrying the legacy ``# host-sync: ok`` comment (kept for back-compat with
+``scripts/check_host_sync.py`` call sites), and the engine-wide
+``# lint: ok[host-sync]`` suppression.
+
+This module is also the implementation behind the ``scripts/check_host_sync.py``
+compat shim: ``check_file``/``check_paths`` keep the original
+``List[(path, line, message)]`` return shape and semantics.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterator, List, Optional, Set, Tuple
+
+from ..engine import Finding, ModuleContext, Rule
+
+# names whose float() is host-side arithmetic, not a device sync
+ALLOWED_FLOAT_ROOTS = {
+    "cfg", "wm_cfg", "moments_cfg", "os", "np", "math", "time", "sys",
+    "int", "float", "len", "state", "world_size", "deadline",
+}
+ASARRAY_FUNCS = {("np", "asarray"), ("jnp", "asarray"), ("np", "array"), ("jnp", "array")}
+ALLOW_COMMENT = "# host-sync: ok"
+CADENCE_NAMES = {"last_log", "log_every", "dry_run", "last_checkpoint"}
+
+
+def root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.func if isinstance(node, ast.Call) else node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def is_hot_entrypoint(fn: ast.FunctionDef) -> bool:
+    """A registered train loop or a ``*_loop`` thread/worker entry — the
+    functions whose loop bodies are the per-step hot path."""
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = target.attr if isinstance(target, ast.Attribute) else getattr(target, "id", "")
+        if name == "register_algorithm":
+            return True
+    return fn.name.endswith("_loop")
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)} | {
+        n.attr for n in ast.walk(node) if isinstance(n, ast.Attribute)
+    }
+
+
+class _HotLoopChecker(ast.NodeVisitor):
+    def __init__(self, path: Path, source_lines: List[str]):
+        self.path = path
+        self.lines = source_lines
+        self.violations: List[Tuple[Path, int, str]] = []
+        self._loop_depth = 0
+        self._cadence_depth = 0  # inside a log/ckpt-cadence `if`
+        self._metrics_aliases: Set[str] = {"metrics"}
+
+    # -- scope plumbing ----------------------------------------------------
+    def visit_loop(self, node: ast.AST) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_While = visit_loop
+
+    def visit_If(self, node: ast.If) -> None:
+        cadence = bool(_names_in(node.test) & CADENCE_NAMES)
+        if cadence:
+            self._cadence_depth += 1
+        self.generic_visit(node)
+        if cadence:
+            self._cadence_depth -= 1
+
+    def _track_metrics_alias(self, node: ast.For) -> None:
+        """`for k, v in metrics.items():` makes `v` a metrics alias."""
+        it = node.iter
+        if (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Attribute)
+            and it.func.attr == "items"
+            and root_name(it.func.value) in self._metrics_aliases
+        ):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    self._metrics_aliases.add(t.id)
+
+    # -- the checks --------------------------------------------------------
+    def _allowed_line(self, lineno: int) -> bool:
+        line = self.lines[lineno - 1] if lineno - 1 < len(self.lines) else ""
+        return ALLOW_COMMENT in line
+
+    def _flag(self, node: ast.AST, msg: str) -> None:
+        if self._loop_depth == 0 or self._cadence_depth > 0:
+            return
+        if self._allowed_line(node.lineno):
+            return
+        self.violations.append((self.path, node.lineno, msg))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        # <expr>.item()
+        if isinstance(fn, ast.Attribute) and fn.attr == "item" and not node.args:
+            self._flag(node, ".item() host sync in a hot loop")
+        # float(<device expr>)
+        if isinstance(fn, ast.Name) and fn.id == "float" and node.args:
+            arg = node.args[0]
+            if not isinstance(arg, ast.Constant) and root_name(arg) not in ALLOWED_FLOAT_ROOTS:
+                self._flag(node, f"float({ast.unparse(arg)}) host sync in a hot loop")
+        # np.asarray(metrics) / np.asarray(v) with v from metrics.items()
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            if (fn.value.id, fn.attr) in ASARRAY_FUNCS and node.args:
+                root = root_name(node.args[0])
+                if root in self._metrics_aliases:
+                    self._flag(
+                        node,
+                        f"{fn.value.id}.{fn.attr}({ast.unparse(node.args[0])}) materializes "
+                        "train metrics per step (defer to the log-cadence flush)",
+                    )
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:  # noqa: N802 — ast API
+        self._track_metrics_alias(node)
+        self.visit_loop(node)
+
+
+def _check_tree(path: Path, lines: List[str], tree: ast.Module) -> List[Tuple[Path, int, str]]:
+    out: List[Tuple[Path, int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and is_hot_entrypoint(node):
+            checker = _HotLoopChecker(path, lines)
+            for stmt in node.body:
+                checker.visit(stmt)
+            out.extend(checker.violations)
+    return out
+
+
+class HostSyncRule(Rule):
+    """Hidden device→host sync (.item()/float()/asarray(metrics)) in a hot loop."""
+
+    rule_id = "host-sync"
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for path, lineno, msg in _check_tree(ctx.path, ctx.lines, ctx.tree):
+            yield Finding(
+                self.rule_id,
+                str(path),
+                lineno,
+                msg,
+                remediation=(
+                    "hold the value as a device ref until the log-cadence flush, or "
+                    "annotate the line with `# host-sync: ok (<cadence>)`"
+                ),
+            )
+
+
+# -- compat API for scripts/check_host_sync.py -------------------------------
+
+
+def check_file(path: Path) -> List[Tuple[Path, int, str]]:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as err:
+        return [(path, err.lineno or 0, f"syntax error: {err.msg}")]
+    return _check_tree(path, source.splitlines(), tree)
+
+
+def check_paths(paths: List[Path]) -> List[Tuple[Path, int, str]]:
+    files: List[Path] = []
+    for p in paths:
+        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+    out: List[Tuple[Path, int, str]] = []
+    for f in files:
+        out.extend(check_file(f))
+    return out

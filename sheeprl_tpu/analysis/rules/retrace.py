@@ -1,0 +1,220 @@
+"""retrace-hazard: host-varying values flowing into jitted calls.
+
+The serve/engine warmup contracts pin ``retraces_since_warmup == 0`` — a jit
+signature that changes after warmup silently re-pays compile time (seconds to
+minutes on TPU) in the middle of the hot path. The hazards this rule catches
+at the call sites of module-local jitted functions (see
+:mod:`..jitsites` for how those are discovered):
+
+* an f-string, a ``time.*()`` result, or a ``len(...)`` result passed in a
+  **static** position (``static_argnums``/``static_argnames``): a new value
+  every call → a new cache entry and a full retrace every call;
+* the same host-varying values passed in a **traced** position: strings are
+  invalid traced args outright, and a fresh Python scalar per call forces a
+  host→device transfer and a weak-type promotion hazard on every step —
+  either name the arg in ``static_argnames`` (if it's genuinely static) or
+  stage it to a device array once outside the loop;
+* a non-hashable literal (list/dict/set or ``np.array(...)``) in a static
+  position: ``jax.jit`` requires hashable statics — this raises (or, for
+  types with value-equality ``__hash__`` shims, retraces unpredictably).
+
+Host-varying-ness is tracked through simple local aliases
+(``t = time.perf_counter()`` … ``f(t)`` is flagged like ``f(time.perf_counter())``).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set
+
+from ..engine import Finding, ModuleContext, Rule
+from ..jitsites import JitSite, callee_site, collect_jit_sites
+
+NONHASHABLE_ARRAY_FUNCS = {
+    "np.array", "np.asarray", "numpy.array", "numpy.asarray",
+    "jnp.array", "jnp.asarray", "jax.numpy.array", "jax.numpy.asarray",
+}
+
+
+def _hazard_kind(ctx: ModuleContext, node: ast.AST) -> Optional[str]:
+    """f-string / time.* / len() — a host value that varies per call."""
+    if isinstance(node, ast.JoinedStr):
+        return "an f-string"
+    if isinstance(node, ast.Call):
+        dotted = ctx.call_dotted(node)
+        if dotted is not None and (dotted == "time" or dotted.startswith("time.")):
+            return f"a {dotted}() result"
+        if dotted == "len":
+            return f"len({ast.unparse(node.args[0]) if node.args else ''})"
+    return None
+
+
+def _scan_roots(tree: ast.Module) -> list:
+    """FunctionDefs not nested inside another function (module-level defs
+    and class methods)."""
+    roots: list = []
+
+    def rec(node: ast.AST, in_fn: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not in_fn:
+                    roots.append(child)
+                rec(child, True)
+            else:
+                rec(child, in_fn)
+
+    rec(tree, False)
+    return roots
+
+
+def _non_hashable(ctx: ModuleContext, node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return ctx.call_dotted(node) in NONHASHABLE_ARRAY_FUNCS
+    return False
+
+
+class _FunctionScanner(ast.NodeVisitor):
+    """Scans one top-level function (closures included — they see the
+    enclosing aliases; their own params shadow them). The alias table is
+    per-scanner, so a hazard-tainted name in one function can never taint an
+    identically-named binding in another."""
+
+    def __init__(self, rule: "RetraceHazardRule", ctx: ModuleContext, sites: Dict[str, JitSite]):
+        self.rule = rule
+        self.ctx = ctx
+        self.sites = sites
+        self.findings: list = []
+        # local name -> hazard description, tracked linearly
+        self._aliases: Dict[str, str] = {}
+
+    def _shadow_args(self, args: ast.arguments) -> Set[str]:
+        return {p.arg for p in args.posonlyargs + args.args + args.kwonlyargs}
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        saved = dict(self._aliases)
+        for name in self._shadow_args(node.args):
+            self._aliases.pop(name, None)
+        self.generic_visit(node)
+        self._aliases = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved = dict(self._aliases)
+        for name in self._shadow_args(node.args):
+            self._aliases.pop(name, None)
+        self.generic_visit(node)
+        self._aliases = saved
+
+    def _kill_target(self, target: ast.AST) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                self._aliases.pop(n.id, None)
+
+    def visit_For(self, node: ast.For) -> None:
+        self._kill_target(node.target)
+        self.generic_visit(node)
+
+    def visit_withitem(self, node: ast.withitem) -> None:
+        if node.optional_vars is not None:
+            self._kill_target(node.optional_vars)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+        self._kill_target(node.target)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        kind = _hazard_kind(self.ctx, node.value)
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                if kind is not None:
+                    self._aliases[target.id] = kind
+                else:
+                    self._aliases.pop(target.id, None)
+            else:
+                self._kill_target(target)
+
+    def _arg_hazard(self, node: ast.AST) -> Optional[str]:
+        kind = _hazard_kind(self.ctx, node)
+        if kind is not None:
+            return kind
+        if isinstance(node, ast.Name) and node.id in self._aliases:
+            return self._aliases[node.id]
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        site = callee_site(self.sites, node)
+        if site is None:
+            return
+        static_pos = site.static_positions()
+        checks: list = []  # (arg node, is_static, label)
+        for i, arg in enumerate(node.args):
+            if isinstance(arg, ast.Starred):
+                continue
+            label = site.params[i] if i < len(site.params) else f"arg {i}"
+            checks.append((arg, i in static_pos, label))
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue
+            checks.append((kw.value, kw.arg in site.static_argnames, kw.arg))
+        for arg, is_static, label in checks:
+            hazard = self._arg_hazard(arg)
+            if hazard is not None:
+                if is_static:
+                    self.findings.append(
+                        Finding(
+                            self.rule.rule_id,
+                            str(self.ctx.path),
+                            arg.lineno,
+                            f"{hazard} passed as STATIC arg `{label}` of jitted "
+                            f"`{site.name}` — a fresh value every call retraces every call",
+                            remediation="pass a stable value, or hash-cons it outside the hot path",
+                        )
+                    )
+                else:
+                    self.findings.append(
+                        Finding(
+                            self.rule.rule_id,
+                            str(self.ctx.path),
+                            arg.lineno,
+                            f"{hazard} flows into traced arg `{label}` of jitted "
+                            f"`{site.name}` (not named in static_argnames)",
+                            remediation=(
+                                "stage host scalars to a device array outside the loop, or name "
+                                "the arg in static_argnames if it is genuinely static"
+                            ),
+                        )
+                    )
+            elif is_static and _non_hashable(self.ctx, arg):
+                self.findings.append(
+                    Finding(
+                        self.rule.rule_id,
+                        str(self.ctx.path),
+                        arg.lineno,
+                        f"non-hashable literal passed as STATIC arg `{label}` of jitted "
+                        f"`{site.name}` — jax.jit statics must be hashable",
+                        remediation="use a tuple / frozen container, or make the arg traced",
+                    )
+                )
+
+
+class RetraceHazardRule(Rule):
+    """Host-varying value (f-string, time.*, len()) or non-hashable static in a jitted call."""
+
+    rule_id = "retrace-hazard"
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        sites = collect_jit_sites(ctx)
+        if not sites:
+            return
+        # one scanner per top-level function (module- or class-level def):
+        # closures are scanned inside their parent so they inherit aliases,
+        # and sibling functions can't leak aliases into each other
+        for fn in _scan_roots(ctx.tree):
+            scanner = _FunctionScanner(self, ctx, sites)
+            scanner.visit(fn)
+            yield from scanner.findings

@@ -1,0 +1,191 @@
+"""rng-reuse: a jax.random key must be consumed exactly once.
+
+Reusing a PRNG key gives two "independent" samples perfectly correlated
+noise — the classic silent-correctness bug in JAX RL loops (exploration
+noise identical across steps, dropout masks equal across ensemble members).
+The functional API makes this a *data-flow* property, so it lints:
+
+* a name that holds a key (assigned from ``jax.random.PRNGKey`` / ``split``
+  / ``fold_in``, or unpacked from a ``split``) is **consumed** when passed
+  to ``jax.random.split`` / ``fold_in`` / any ``jax.random.*`` sampler, or
+  as a ``key=`` / ``rng=`` keyword to any call, or positionally to any
+  non-data-movement call. Any use of the same name after consumption,
+  without a reassignment in between, is a finding —
+  ``key, sub = jax.random.split(key)`` is the sanctioned shape;
+* a key consumed **inside a loop** without being reassigned anywhere in
+  that loop body is reused on every iteration (linear order can't see it,
+  the loop back-edge does). ``fold_in(key, <varying>)`` is exempt — deriving
+  per-step keys from a constant root is exactly what fold_in is for; only a
+  *constant* fold_in data arg (same derived key each iteration) is flagged;
+* ``jax.random.PRNGKey(...)`` constructed inside a hot loop (a
+  ``@register_algorithm`` / ``*_loop`` function): re-seeding per step either
+  reuses the seed (constant → identical streams) or re-keys from step data —
+  both belong outside the loop with ``split``/``fold_in`` chaining.
+
+The walk is per-function, linear, and closure-aware — see
+:mod:`..dataflow` for the shared control-flow semantics (exclusive
+``if/else`` branches, loop back-edges, comprehension scoping).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from ..dataflow import LinearWalker, comprehension_targets, store_names
+from ..engine import Finding, ModuleContext, Rule
+from .host_sync import is_hot_entrypoint
+
+KEY_PRODUCERS = {"jax.random.PRNGKey", "jax.random.key", "jax.random.split", "jax.random.fold_in"}
+NON_CONSUMING = {"jax.random.PRNGKey", "jax.random.key", "jax.random.key_data", "jax.random.wrap_key_data"}
+# NOT `seed`: integer seeds (env constructors, config) are host values, not keys
+KEY_KWARGS = {"key", "rng"}
+# passing a key here moves/transforms it without drawing randomness from it
+NON_CONSUMING_PREFIXES = (
+    "jnp.", "np.", "numpy.", "jax.numpy.", "jax.tree", "jax.debug", "jax.lax.",
+)
+NON_CONSUMING_TERMINALS = {
+    "print", "len", "repr", "str", "type", "id", "isinstance", "list", "tuple",
+    "dict", "set", "bool", "int", "float", "getattr", "hasattr", "sorted",
+    "enumerate", "zip", "range", "device_put", "block_until_ready", "stop_gradient",
+}
+
+
+def _consumes_positionally(dotted: str) -> bool:
+    """A call that receives a key positionally is assumed to draw from it —
+    unless it is a pure data-movement/introspection callee."""
+    if dotted in NON_CONSUMING:
+        return False
+    if any(dotted.startswith(p) for p in NON_CONSUMING_PREFIXES):
+        return False
+    return dotted.rsplit(".", 1)[-1] not in NON_CONSUMING_TERMINALS
+
+
+class _FnWalker(LinearWalker):
+    STATE_ATTRS = ("consumed", "keys")
+
+    def __init__(
+        self,
+        rule: "RngReuseRule",
+        ctx: ModuleContext,
+        fn: ast.FunctionDef,
+        inherited_keys: Set[str] = frozenset(),
+    ):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        self.fn = fn
+        self.hot = is_hot_entrypoint(fn)
+        self.findings: List[Finding] = []
+        # closures see the enclosing function's keys (droq's actor_loss_fn
+        # closing over actor_key is the motivating case)
+        self.keys: Set[str] = set(inherited_keys)
+        # key-shaped parameters participate from the start: a function that
+        # takes `key`/`rng`/`*_key` and double-consumes it is the same bug
+        a = fn.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            name = p.arg
+            if name in KEY_KWARGS or name.endswith("_key") or name.endswith("_rng") or name.startswith("key_"):
+                self.keys.add(name)
+        self.consumed: Dict[str, Tuple[int, str]] = {}  # name -> (line, by)
+
+    def _flag(self, line: int, msg: str, remediation: str) -> None:
+        self.findings.append(
+            Finding(self.rule.rule_id, str(self.ctx.path), line, msg, remediation=remediation)
+        )
+
+    # -- hooks -------------------------------------------------------------
+    def on_expr(self, expr: ast.AST) -> None:
+        self._check_uses(expr)
+        self._consumptions(expr)
+
+    def on_store(self, target: ast.AST, value) -> None:
+        names = store_names(target)
+        for name in names:
+            self.consumed.pop(name, None)
+        if (
+            value is not None
+            and isinstance(value, ast.Call)
+            and self.ctx.call_dotted(value) in KEY_PRODUCERS
+        ):
+            self.keys |= names
+
+    def on_delete(self, name: str) -> None:
+        self.consumed.pop(name, None)
+        self.keys.discard(name)
+
+    # -- the checks --------------------------------------------------------
+    def _check_uses(self, expr: ast.AST) -> None:
+        shadowed = comprehension_targets(expr)
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in shadowed:
+                continue  # comprehension variable: its own scope, not the key
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) and n.id in self.consumed:
+                line, by = self.consumed.pop(n.id)
+                self._flag(
+                    n.lineno,
+                    f"PRNG key `{n.id}` used again after being consumed by {by} at line {line}",
+                    "split the key first: `key, sub = jax.random.split(key)` and use `sub`",
+                )
+
+    def _consumptions(self, expr: ast.AST) -> None:
+        for call in ast.walk(expr):
+            if not isinstance(call, ast.Call):
+                continue
+            dotted = self.ctx.call_dotted(call) or ""
+            hot_loop = self.hot and bool(self.loop_stores)
+            if dotted == "jax.random.PRNGKey" and hot_loop:
+                self._flag(
+                    call.lineno,
+                    "PRNG key constructed inside a hot loop",
+                    "seed once outside the loop and chain with split/fold_in per step",
+                )
+            consumed_names: List[Tuple[str, ast.AST]] = []
+            # an unresolvable callee (e.g. `factory()(key)`) still consumes:
+            # only a KNOWN data-movement callee is exempt
+            consumes = not dotted or _consumes_positionally(dotted)
+            if consumes:
+                for arg in call.args:
+                    if isinstance(arg, ast.Name) and arg.id in self.keys:
+                        consumed_names.append((arg.id, call))
+            if consumes:
+                for kw in call.keywords:
+                    if kw.arg in KEY_KWARGS and isinstance(kw.value, ast.Name) and kw.value.id in self.keys:
+                        consumed_names.append((kw.value.id, call))
+            for name, at in consumed_names:
+                by = dotted or "a consuming call"
+                self.consumed[name] = (at.lineno, by)
+                # back-edge: consumed in a loop whose body never reassigns it
+                if self.loop_stores and not any(name in s for s in self.loop_stores):
+                    exempt_fold_in = (
+                        dotted == "jax.random.fold_in"
+                        and len(call.args) > 1
+                        and not isinstance(call.args[1], ast.Constant)
+                    )
+                    if not exempt_fold_in:
+                        self._flag(
+                            at.lineno,
+                            f"PRNG key `{name}` consumed by {by} inside a loop without "
+                            "reassignment — the same key is reused every iteration",
+                            "carry the key through the loop: `key, sub = jax.random.split(key)`",
+                        )
+
+
+class RngReuseRule(Rule):
+    """jax.random key reused after split/fold_in/sampling, or re-seeded in a hot loop."""
+
+    rule_id = "rng-reuse"
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        yield from self._visit_scope(ctx, ctx.tree, frozenset())
+
+    def _visit_scope(self, ctx: ModuleContext, node: ast.AST, inherited: Set[str]) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.FunctionDef):
+                walker = _FnWalker(self, ctx, child, inherited)
+                walker.walk_body(child.body)
+                yield from walker.findings
+                # nested defs close over every key name the parent ended
+                # with (params + producer-assigned locals)
+                yield from self._visit_scope(ctx, child, set(walker.keys))
+            else:
+                yield from self._visit_scope(ctx, child, inherited)

@@ -71,8 +71,14 @@ def check_configs(cfg: Config) -> None:
     if algo_name is None:
         raise ValueError("Missing `algo.name`: select an experiment with `exp=<name>`")
     if algo_name not in algorithm_registry:
+        hint = (
+            " (SHEEPRL_TPU_LINT_LIGHT is set: algorithm registration was skipped — "
+            "that variable is for the lint entry points only, unset it for run/eval)"
+            if os.environ.get("SHEEPRL_TPU_LINT_LIGHT")
+            else ""
+        )
         raise ValueError(
-            f"Algorithm '{algo_name}' is not registered. Available: {sorted(algorithm_registry)}"
+            f"Algorithm '{algo_name}' is not registered. Available: {sorted(algorithm_registry)}{hint}"
         )
     strategy = cfg.select("fabric.strategy", "auto")
     if strategy not in ("auto", "ddp", "dp", None):
@@ -320,6 +326,21 @@ def doctor(args: Optional[Sequence[str]] = None) -> None:
         raise SystemExit(rc)
 
 
+def lint(args: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl_tpu lint [paths...] [--json] [--rule r1,r2] [--list-rules]` —
+    the JAX-aware static-analysis pass (analysis/): host-sync, retrace-hazard,
+    rng-reuse, use-after-donate, thread-shared-state and
+    telemetry-schema-drift rules over the given paths (default: the whole
+    sheeprl_tpu package). Exits 1 on any unsuppressed finding; suppress a
+    line with `# lint: ok[<rule>] <reason>`. See howto/static_analysis.md."""
+    argv = list(args if args is not None else sys.argv[1:])
+    from .analysis.engine import main as lint_main
+
+    rc = lint_main(argv)
+    if rc:
+        raise SystemExit(rc)
+
+
 def registration(args: Optional[Sequence[str]] = None) -> None:
     """`sheeprl_tpu registration checkpoint_path=... [backend=mlflow]` —
     register a trained model, split per the algo's MODELS_TO_REGISTER
@@ -383,10 +404,11 @@ def available_agents() -> None:
 
 
 def main() -> None:
-    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|gateway|doctor|registration|agents> ...`"""
+    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|gateway|doctor|lint|registration|agents> ...`"""
     argv = sys.argv[1:]
     if argv and argv[0] in (
-        "run", "eval", "evaluation", "resume", "serve", "gateway", "doctor", "registration", "agents"
+        "run", "eval", "evaluation", "resume", "serve", "gateway", "doctor", "lint",
+        "registration", "agents",
     ):
         cmd, rest = argv[0], argv[1:]
     else:
@@ -403,6 +425,8 @@ def main() -> None:
         gateway(rest)
     elif cmd == "doctor":
         doctor(rest)
+    elif cmd == "lint":
+        lint(rest)
     elif cmd == "registration":
         registration(rest)
     elif cmd == "agents":

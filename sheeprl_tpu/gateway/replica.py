@@ -371,7 +371,6 @@ class ReplicaManager:
             if handle.state == "running":
                 proc = handle.proc
                 if proc is not None and proc.exitcode is not None and not self._stopping:
-                    self.crashes += 1
                     self.fault(handle, "crash", detail=f"exitcode={proc.exitcode}")
                     continue
                 healthy = self._check_health(handle)
@@ -381,14 +380,12 @@ class ReplicaManager:
                     # still starting (interpreter + jax import + warmup):
                     # judged against the spawn grace budget, not hang_s
                     if now - handle.spawned_at > self.spawn_grace_s:
-                        self.hangs += 1
                         self.fault(
                             handle,
                             "hang",
                             detail=f"not healthy within {self.spawn_grace_s:.0f}s of spawn",
                         )
                 elif now - handle.last_healthy > self.hang_s:
-                    self.hangs += 1
                     self.fault(
                         handle,
                         "hang",
@@ -397,6 +394,7 @@ class ReplicaManager:
             elif handle.state == "backoff" and now >= handle.respawn_at:
                 handle.incarnation += 1
                 handle.respawns += 1
+                # lint: ok[thread-shared-state] respawns happen only in the monitor sweep — tests drive monitor_once synchronously with the thread stopped, never both
                 self.total_respawns += 1
                 self._spawn(handle)
 
@@ -419,6 +417,13 @@ class ReplicaManager:
     def _fault_locked(self, handle: ReplicaHandle, reason: str, detail: str) -> None:
         if handle.state != "running":
             return
+        if reason == "crash":
+            # counted here, not at the observation sites: the monitor sweep
+            # and a request thread can both see the same death — the lock +
+            # state re-check above make it one fault, and no lost updates
+            self.crashes += 1
+        elif reason == "hang":
+            self.hangs += 1
         proc, handle.proc = handle.proc, None
         if proc is not None and proc.is_alive():
             proc.kill()
@@ -476,7 +481,6 @@ class ReplicaManager:
         handle.suspect = True
         proc = handle.proc
         if proc is not None and proc.exitcode is not None and not self._stopping:
-            self.crashes += 1
             self.fault(
                 handle,
                 "crash",

@@ -52,6 +52,11 @@ class CheckpointReloader:
         self._sink = sink
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # poll_once is reachable from two threads — the background _loop and
+        # any HTTP admin request (`POST /admin/reload`, server.py): without
+        # the lock, two concurrent polls both pass the `step <= loaded_step`
+        # check and double-swap the same checkpoint
+        self._poll_lock = threading.Lock()
 
     def _emit(self, rec: Dict[str, Any]) -> None:
         if self._sink is None:
@@ -62,7 +67,12 @@ class CheckpointReloader:
             pass
 
     def poll_once(self) -> bool:
-        """Check for a newer checkpoint; swap if found. Returns True on swap."""
+        """Check for a newer checkpoint; swap if found. Returns True on swap.
+        Serialized: the poll thread and admin-reload requests may overlap."""
+        with self._poll_lock:
+            return self._poll_once_locked()
+
+    def _poll_once_locked(self) -> bool:
         ckpts = _list_checkpoints(self.ckpt_dir)
         if not ckpts:
             return False

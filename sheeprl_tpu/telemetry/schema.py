@@ -50,6 +50,7 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "step": (True, _NUM),
         "xla": (False, _DICT),
         "spans": (False, _DICT),
+        "total_grad_steps": (False, _NUM),
     },
     # TensorBoardLogger fallback stream (satellite: metrics never dropped)
     "metrics": {

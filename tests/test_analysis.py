@@ -1,0 +1,714 @@
+"""sheeprl_tpu/analysis — the JAX-aware static-analysis framework.
+
+Per rule: one red fixture (the exact finding — rule_id, file, line — and
+exit code 1) and one green fixture (no findings). Plus: suppression
+comments, `--json` round-trip, the `--rule` filter, and the tier-1
+"repo lints clean" invariant over the whole sheeprl_tpu package.
+"""
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+from pathlib import Path
+
+from sheeprl_tpu.analysis import all_rules, run_paths
+from sheeprl_tpu.analysis.engine import main as lint_main
+from sheeprl_tpu.analysis.rules.donation import UseAfterDonateRule
+from sheeprl_tpu.analysis.rules.host_sync import HostSyncRule
+from sheeprl_tpu.analysis.rules.retrace import RetraceHazardRule
+from sheeprl_tpu.analysis.rules.rng import RngReuseRule
+from sheeprl_tpu.analysis.rules.telemetry_schema import TelemetrySchemaRule
+from sheeprl_tpu.analysis.rules.threads import ThreadSharedStateRule
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _lint(tmp_path, code, rule, name="snippet.py"):
+    f = tmp_path / name
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(code))
+    return run_paths([f], [rule]), f
+
+
+# ---------------------------------------------------------------- host-sync
+def test_host_sync_red(tmp_path):
+    findings, f = _lint(
+        tmp_path,
+        """
+        @register_algorithm(name="fake")
+        def main(dist, cfg):
+            while policy_step < total_steps:
+                loss = train(params)
+                x = loss.item()
+        """,
+        HostSyncRule(),
+    )
+    assert len(findings) == 1
+    assert findings[0].rule_id == "host-sync"
+    assert findings[0].path == str(f) and findings[0].line == 6
+    assert ".item()" in findings[0].message
+
+
+def test_host_sync_green(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        @register_algorithm(name="fake")
+        def main(dist, cfg):
+            while policy_step < total_steps:
+                metrics = train(params)
+                if policy_step - last_log >= cfg.metric.log_every:
+                    flush(metrics)
+        """,
+        HostSyncRule(),
+    )
+    assert findings == []
+
+
+# ----------------------------------------------------------- retrace-hazard
+RETRACE_RED = """
+    import time
+    from functools import partial
+    import jax
+
+    @partial(jax.jit, static_argnames=("tag",))
+    def step(x, tag):
+        return x
+
+    def loop(x):
+        step(x, tag=f"step_{x}")
+        step(time.perf_counter(), tag="a")
+        step(x, tag=[1, 2])
+"""
+
+
+def test_retrace_red(tmp_path):
+    findings, f = _lint(tmp_path, RETRACE_RED, RetraceHazardRule())
+    assert [x.line for x in findings] == [11, 12, 13]
+    assert all(x.rule_id == "retrace-hazard" for x in findings)
+    assert "f-string" in findings[0].message and "STATIC" in findings[0].message
+    assert "time.perf_counter" in findings[1].message and "traced arg" in findings[1].message
+    assert "non-hashable" in findings[2].message
+
+
+def test_retrace_green(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("greedy",))
+        def step(x, greedy):
+            return x
+
+        def loop(x):
+            step(x, greedy=True)
+            step(x, greedy=False)
+        """,
+        RetraceHazardRule(),
+    )
+    assert findings == []
+
+
+def test_retrace_tracks_host_scalar_aliases(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import jax
+
+        fast = jax.jit(lambda x: x)
+
+        def loop(buffer):
+            n = len(buffer)
+            fast(n)
+        """,
+        RetraceHazardRule(),
+    )
+    assert len(findings) == 1 and "len(buffer)" in findings[0].message
+
+
+# -------------------------------------------------------------- rng-reuse
+def test_rng_red(tmp_path):
+    findings, f = _lint(
+        tmp_path,
+        """
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))
+            return a + b
+        """,
+        RngReuseRule(),
+    )
+    assert len(findings) == 1
+    assert findings[0].rule_id == "rng-reuse"
+    assert findings[0].line == 6
+    assert "`key` used again" in findings[0].message
+
+
+def test_rng_green_split_chain(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import jax
+
+        def train_loop(key):
+            while True:
+                key, sub = jax.random.split(key)
+                act = jax.random.normal(sub, (3,))
+        """,
+        RngReuseRule(),
+    )
+    assert findings == []
+
+
+def test_rng_hot_loop_construction_and_loop_reuse(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import jax
+
+        def train_loop(key):
+            while True:
+                k0 = jax.random.PRNGKey(0)
+                jax.random.normal(key, (2,))
+        """,
+        RngReuseRule(),
+    )
+    msgs = [x.message for x in findings]
+    assert any("constructed inside a hot loop" in m for m in msgs)
+    assert any("reused every iteration" in m for m in msgs)
+
+
+def test_rng_exclusive_branches_are_not_reuse(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import jax
+
+        def sample(key, continuous):
+            if continuous:
+                return jax.random.normal(key, (3,))
+            else:
+                return jax.random.categorical(key, logits)
+        """,
+        RngReuseRule(),
+    )
+    assert findings == []
+
+
+def test_rng_fold_in_with_varying_data_in_loop_is_fine(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import jax
+
+        def train_loop(key):
+            for step in range(100):
+                k = jax.random.fold_in(key, step)
+                use(k)
+        """,
+        RngReuseRule(),
+    )
+    assert findings == []
+
+
+def test_rng_closure_sees_enclosing_key(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import jax
+
+        def train(actor_key):
+            def loss_fn(p):
+                a = sample(p, actor_key)
+                b = other(p, actor_key)
+                return a + b
+            return loss_fn
+        """,
+        RngReuseRule(),
+    )
+    assert len(findings) == 1 and "`actor_key`" in findings[0].message
+
+
+# --------------------------------------------------------- use-after-donate
+def test_donation_red(tmp_path):
+    findings, f = _lint(
+        tmp_path,
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train(params, batch):
+            return params
+
+        def loop(params, batch):
+            out = train(params, batch)
+            return params
+        """,
+        UseAfterDonateRule(),
+    )
+    assert len(findings) == 1
+    assert findings[0].rule_id == "use-after-donate"
+    assert findings[0].line == 11
+    assert "`params` read after being donated" in findings[0].message
+
+
+def test_donation_green_rebinds(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def train(params, opt_state, batch):
+            return params, opt_state
+
+        def loop(params, opt_state, batches):
+            for batch in batches:
+                params, opt_state = train(params, opt_state, batch)
+            return params
+        """,
+        UseAfterDonateRule(),
+    )
+    assert findings == []
+
+
+def test_donation_loop_without_rebinding(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train(params, batch):
+            return params
+
+        def loop(params, batches):
+            for batch in batches:
+                train(params, batch)
+        """,
+        UseAfterDonateRule(),
+    )
+    assert len(findings) == 1 and "next iteration donates" in findings[0].message
+
+
+# ------------------------------------------------------ thread-shared-state
+THREADS_RED = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._thread = None
+
+        def start(self):
+            self.count = 0
+            self._thread = threading.Thread(target=self._run)
+            self._thread.start()
+            self.count += 1
+
+        def _run(self):
+            while True:
+                self.count += 1
+"""
+
+
+def test_threads_red(tmp_path):
+    findings, f = _lint(tmp_path, THREADS_RED, ThreadSharedStateRule(), name="engine/worker.py")
+    assert [x.line for x in findings] == [14, 18]
+    assert all(x.rule_id == "thread-shared-state" for x in findings)
+    assert "`self.count`" in findings[0].message
+
+
+def test_threads_pre_spawn_write_is_happens_before(tmp_path):
+    # the write at line 11 (before .start()) must NOT be flagged
+    findings, _ = _lint(tmp_path, THREADS_RED, ThreadSharedStateRule(), name="engine/worker.py")
+    assert 11 not in [x.line for x in findings]
+
+
+def test_threads_green_lock_and_atomics(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import queue
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self.q = queue.Queue()
+                self._thread = None
+
+            def start(self):
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+
+            def _run(self):
+                while True:
+                    with self._lock:
+                        self.count += 1
+                    self.q.put(self.snapshot_locked())
+
+            def snapshot_locked(self):
+                return self.count
+        """,
+        ThreadSharedStateRule(),
+        name="engine/worker.py",
+    )
+    assert findings == []
+
+
+def test_threads_rule_scoped_to_threaded_subsystems(tmp_path):
+    # same red code outside engine/fleet/gateway/serve: out of scope
+    findings, _ = _lint(tmp_path, THREADS_RED, ThreadSharedStateRule(), name="algos/worker.py")
+    assert findings == []
+
+
+# ------------------------------------------------- telemetry-schema-drift
+FAKE_SCHEMA = {
+    "demo": {"step": (True, int), "detail": (False, str)},
+}
+
+
+def test_schema_red_unknown_event_missing_and_extra_fields(tmp_path):
+    rule = TelemetrySchemaRule(schema=FAKE_SCHEMA)
+    findings, f = _lint(
+        tmp_path,
+        """
+        def report(telem, step):
+            telem.emit({"event": "nope", "step": step})
+            telem.emit({"event": "demo"})
+            rec = {"event": "demo", "step": step, "bogus": 1}
+            telem.emit(rec)
+        """,
+        rule,
+    )
+    assert [x.line for x in findings] == [3, 4, 6]
+    assert all(x.rule_id == "telemetry-schema-drift" for x in findings)
+    assert "unknown event 'nope'" in findings[0].message
+    assert "required field 'step' is missing" in findings[1].message
+    assert "'bogus' is not declared" in findings[2].message
+
+
+def test_schema_green(tmp_path):
+    rule = TelemetrySchemaRule(schema=FAKE_SCHEMA)
+    findings, _ = _lint(
+        tmp_path,
+        """
+        def report(telem, step):
+            telem.emit({"event": "demo", "step": step})
+            rec = {"event": "demo", "step": step, "detail": "x"}
+            telem.emit(rec)
+            # dynamic additions downgrade the missing-field check
+            partial = {"event": "demo"}
+            partial["step"] = step
+            telem.emit(partial)
+        """,
+        rule,
+    )
+    assert findings == []
+
+
+def test_schema_real_repo_emit_sites_validate():
+    # the actual telemetry facade + subsystems against the actual schema
+    findings = run_paths([REPO / "sheeprl_tpu" / "telemetry"], [TelemetrySchemaRule()])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------------------------- suppression
+def test_suppression_same_line_and_line_above(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))  # lint: ok[rng-reuse] deliberate twin-sample test
+            # lint: ok[rng-reuse] deliberate second reuse
+            c = jax.random.normal(key, (3,))
+            return a + b + c
+        """,
+        RngReuseRule(),
+    )
+    assert findings == []
+
+
+def test_suppression_wrong_rule_does_not_silence(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))  # lint: ok[host-sync] wrong rule id
+        """,
+        RngReuseRule(),
+    )
+    assert len(findings) == 1
+
+
+def test_suppression_star_silences_all(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))  # lint: ok[*] kitchen sink
+        """,
+        RngReuseRule(),
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------ CLI contract
+def test_cli_exit_codes_and_json_roundtrip(tmp_path, capsys):
+    red = tmp_path / "red.py"
+    red.write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            def f(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))
+            """
+        )
+    )
+    green = tmp_path / "green.py"
+    green.write_text("x = 1\n")
+
+    assert lint_main([str(green)]) == 0
+    capsys.readouterr()
+
+    assert lint_main([str(red), "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["version"] == 1
+    (finding,) = out["findings"]
+    # stable keys for future tooling (doctor fold-in)
+    assert finding["rule_id"] == "rng-reuse"
+    assert finding["file"] == str(red) and finding["line"] == 6
+    assert finding["severity"] == "error"
+    assert "message" in finding and "remediation" in finding
+
+
+def test_cli_rule_filter(tmp_path, capsys):
+    red = tmp_path / "red.py"
+    red.write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            def f(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))
+            """
+        )
+    )
+    # filtering to an unrelated rule: no findings, exit 0
+    assert lint_main([str(red), "--rule", "host-sync"]) == 0
+    capsys.readouterr()
+    assert lint_main([str(red), "--rule", "rng-reuse,host-sync"]) == 1
+    capsys.readouterr()
+    assert lint_main([str(red), "--rule", "no-such-rule"]) == 2
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    findings = run_paths([bad], all_rules())
+    assert len(findings) == 1 and findings[0].rule_id == "syntax-error"
+
+
+# ---------------------------------------------------------------- repo-wide
+def test_repo_lints_clean():
+    """Tier-1 invariant: the whole package passes all six rules with zero
+    unsuppressed findings (ISSUE 9 acceptance)."""
+    findings = run_paths([REPO / "sheeprl_tpu"], all_rules())
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# -------------------------------------------- per-rule exit-code contract
+RED_BY_RULE = {
+    "host-sync": (
+        "snippet.py",
+        """
+        @register_algorithm(name="fake")
+        def main(dist, cfg):
+            while step < total:
+                x = loss.item()
+        """,
+        5,
+    ),
+    "retrace-hazard": (
+        "snippet.py",
+        """
+        import jax
+
+        fast = jax.jit(lambda x: x)
+
+        def loop(x):
+            fast(f"shape_{x}")
+        """,
+        7,
+    ),
+    "rng-reuse": (
+        "snippet.py",
+        """
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))
+        """,
+        6,
+    ),
+    "use-after-donate": (
+        "snippet.py",
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train(params):
+            return params
+
+        def loop(params):
+            train(params)
+            return params
+        """,
+        11,
+    ),
+    "thread-shared-state": ("engine/snippet.py", THREADS_RED, 14),
+    "telemetry-schema-drift": (
+        "snippet.py",
+        """
+        def report(telem):
+            telem.emit({"event": "definitely_not_an_event"})
+        """,
+        3,
+    ),
+}
+
+
+@pytest.mark.parametrize("rule_id", sorted(RED_BY_RULE))
+def test_each_rule_red_fixture_exits_1_with_anchored_finding(tmp_path, capsys, rule_id):
+    """ISSUE 9 acceptance: every rule's red fixture fails with exit 1 and a
+    finding carrying the correct rule_id and file:line."""
+    rel, code, line = RED_BY_RULE[rule_id]
+    f = tmp_path / rel
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(code))
+    assert lint_main([str(f), "--rule", rule_id, "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    anchored = [x for x in out["findings"] if x["rule_id"] == rule_id and x["line"] == line]
+    assert anchored, out["findings"]
+    assert anchored[0]["file"] == str(f)
+
+
+def test_retrace_aliases_do_not_leak_across_functions(tmp_path):
+    # review regression: a hazard-tainted name in one function must not
+    # taint an identically-named parameter in a sibling function
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import time
+        import jax
+
+        fast = jax.jit(lambda x: x)
+
+        def fn_a():
+            t = time.perf_counter()
+            return t
+
+        def fn_b(t):
+            return fast(t)
+        """,
+        RetraceHazardRule(),
+    )
+    assert findings == []
+
+
+def test_threads_public_method_called_from_thread_keeps_caller_root(tmp_path):
+    # review regression: a public method called BOTH by a thread root and by
+    # external request threads (the ReplicaManager.fault shape) must carry
+    # both roots — its unguarded writes are races
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import threading
+
+        class Manager:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._thread = None
+
+            def start(self):
+                self._thread = threading.Thread(target=self._monitor)
+                self._thread.start()
+
+            def _monitor(self):
+                while True:
+                    self.fault()
+
+            def fault(self):
+                self.count += 1
+        """,
+        ThreadSharedStateRule(),
+        name="gateway/manager.py",
+    )
+    assert len(findings) == 1
+    assert "`self.count`" in findings[0].message
+
+
+def test_rng_data_movement_kwarg_does_not_consume(tmp_path):
+    # review regression: dict(key=key) is record-building, not randomness —
+    # the later split must not be reported as reuse
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import jax
+
+        def f(key):
+            meta = dict(key=key)
+            k1, k2 = jax.random.split(key)
+            return meta, k1, k2
+        """,
+        RngReuseRule(),
+    )
+    assert findings == []
+
+
+def test_rng_unresolvable_callee_consumes_positionally(tmp_path):
+    # review regression: `samplers[i](key)` has no resolvable dotted name —
+    # it must still count as consumption so the later reuse is flagged
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import jax
+
+        def f(key, samplers):
+            a = samplers[0](key)
+            b = jax.random.normal(key, (3,))
+        """,
+        RngReuseRule(),
+    )
+    assert len(findings) == 1 and "`key` used again" in findings[0].message

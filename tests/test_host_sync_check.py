@@ -86,3 +86,25 @@ def test_player_loops_are_in_scope(tmp_path):
         """,
     )
     assert len(out) == 1 and ".item()" in out[0][2]
+
+
+def test_shim_import_leaves_no_env_behind():
+    """The shim's light-import trick must not leak SHEEPRL_TPU_LINT_LIGHT
+    into os.environ: a leaked variable would empty the algorithm registry
+    for later package imports and for every spawned child process."""
+    code = (
+        "import sys, os, subprocess\n"
+        "sys.path.insert(0, 'scripts')\n"
+        "import check_host_sync\n"
+        "assert 'SHEEPRL_TPU_LINT_LIGHT' not in os.environ, 'env leaked'\n"
+        "r = subprocess.run([sys.executable, '-c', 'import sheeprl_tpu; "
+        "from sheeprl_tpu.utils.registry import algorithm_registry; "
+        "assert len(algorithm_registry) > 0'], env=os.environ.copy(), cwd='.')\n"
+        "assert r.returncode == 0, 'child registry empty'\n"
+    )
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=str(REPO), capture_output=True, text=True, timeout=120
+    )
+    assert proc.returncode == 0, proc.stderr

@@ -1,6 +1,7 @@
 """scripts/lint.sh — the single lint/gate entry point must stay green on the
-repo itself (host-sync AST lint + bench regression gate in --dry-run), so
-neither check can silently rot out of CI."""
+repo itself (the sheeprl_tpu/analysis rule engine + the host-sync compat
+shim + the bench regression gate in --dry-run), so none of the checks can
+silently rot out of CI."""
 import subprocess
 from pathlib import Path
 
@@ -15,6 +16,8 @@ def test_lint_sh_passes_on_repo():
         timeout=300,
     )
     assert proc.returncode == 0, f"lint.sh failed:\n{proc.stdout}\n{proc.stderr}"
+    # the static-analysis pass ran over the package and came back clean
+    assert "sheeprl_tpu lint: clean" in proc.stdout
     # the bench gate actually ran and printed its report; the verdict itself
     # is deliberately NOT asserted — lint.sh runs the gate in --dry-run so a
     # regression is reported loudly without blocking unrelated CI
