@@ -70,6 +70,15 @@ SERVE_GATED_FIELDS = (
     ("value", "gateway p95 latency", "lower", "rel"),
     ("p99_ms", "gateway p99 latency", "lower", "rel"),
     ("shed_rate", "gateway shed rate", "lower", "abs"),
+    # per-stage breakdown (distributed tracing, PR 10): the flattened
+    # stage p95s bench_serve stamps from the traced acks. Gated with the
+    # same lower-is-better direction so a regression is attributable to a
+    # STAGE (replica jit step vs batcher queue vs transport), not just the
+    # end-to-end number; skipped automatically against pre-tracing rounds
+    # that never carried them.
+    ("stage_forward_p95_ms", "gateway→replica forward p95", "lower", "rel"),
+    ("stage_jit_step_p95_ms", "replica jit-step p95", "lower", "rel"),
+    ("stage_batch_queue_p95_ms", "replica batch-queue p95", "lower", "rel"),
 )
 # absolute shed-rate increase vs the best comparable prior that fails the gate
 DEFAULT_SHED_DELTA = 0.05

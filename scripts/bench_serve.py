@@ -49,7 +49,10 @@ sys.path.insert(0, str(REPO_ROOT))
 
 # -- stats ---------------------------------------------------------------------
 class LoadStats:
-    """Thread-safe counters + latency reservoir for one bench run."""
+    """Thread-safe counters + latency reservoirs for one bench run (the
+    end-to-end latency plus one reservoir per traced stage — the drivers
+    stamp a traceparent on every request, so each ack carries the
+    gateway's and the replica's per-stage timing breakdown)."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -59,8 +62,15 @@ class LoadStats:
         self.errors = 0
         self.mismatches = 0  # acked-state loss: action != acked-step count
         self.latencies_ms: List[float] = []
+        self.stage_ms: Dict[str, List[float]] = {}
 
-    def record(self, status: int, dt_s: float, mismatch: bool = False) -> None:
+    def record(
+        self,
+        status: int,
+        dt_s: float,
+        mismatch: bool = False,
+        timing: Optional[Dict[str, Any]] = None,
+    ) -> None:
         with self._lock:
             self.requests += 1
             if status == 200:
@@ -68,18 +78,38 @@ class LoadStats:
                 self.latencies_ms.append(dt_s * 1000.0)
                 if mismatch:
                     self.mismatches += 1
+                if timing:
+                    for stage, ms in _flatten_timing(timing):
+                        self.stage_ms.setdefault(stage, []).append(ms)
             elif status == 503:
                 self.shed += 1
             else:
                 self.errors += 1
 
+    @staticmethod
+    def _pct(sorted_vals: List[float], p: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        idx = min(len(sorted_vals) - 1, max(0, int(round(p * (len(sorted_vals) - 1)))))
+        return sorted_vals[idx]
+
     def percentile(self, p: float) -> float:
         with self._lock:
             lat = sorted(self.latencies_ms)
-        if not lat:
-            return 0.0
-        idx = min(len(lat) - 1, max(0, int(round(p * (len(lat) - 1)))))
-        return lat[idx]
+        return self._pct(lat, p)
+
+    def stage_percentiles(self) -> Dict[str, Dict[str, float]]:
+        """Per-stage p50/p95/p99 across every traced ack."""
+        with self._lock:
+            stages = {k: sorted(v) for k, v in self.stage_ms.items()}
+        return {
+            stage: {
+                "p50_ms": round(self._pct(vals, 0.50), 3),
+                "p95_ms": round(self._pct(vals, 0.95), 3),
+                "p99_ms": round(self._pct(vals, 0.99), 3),
+            }
+            for stage, vals in sorted(stages.items())
+        }
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -90,6 +120,19 @@ class LoadStats:
                 "errors": self.errors,
                 "mismatches": self.mismatches,
             }
+
+
+def _flatten_timing(timing: Dict[str, Any]) -> List[tuple]:
+    """{'route_ms': 0.1, 'replica': {'jit_step_ms': 2.0}} ->
+    [('route', 0.1), ('jit_step', 2.0)] — one flat stage namespace (the
+    gateway and replica stage names don't collide by construction)."""
+    out: List[tuple] = []
+    for key, val in timing.items():
+        if isinstance(val, dict):
+            out.extend(_flatten_timing(val))
+        elif key.endswith("_ms") and isinstance(val, (int, float)):
+            out.append((key[: -len("_ms")], float(val)))
+    return out
 
 
 # -- traffic -------------------------------------------------------------------
@@ -107,6 +150,8 @@ def closed_loop_worker(
     counted incident, not a mismatch on every subsequent step."""
     import random
 
+    from sheeprl_tpu.telemetry.tracing import make_traceparent, new_span_id, new_trace_id
+
     rng = random.Random(seed)
     while not stop.is_set():
         for sid in sessions:
@@ -116,6 +161,9 @@ def closed_loop_worker(
                 "obs": {"x": [[float(expected[sid])]]},
                 "session_id": sid,
                 "deterministic": rng.random() < low_frac,
+                # every driver request is traced: the ack carries the
+                # gateway+replica per-stage breakdown the record aggregates
+                "traceparent": make_traceparent(new_trace_id(), new_span_id()),
             }
             t0 = time.monotonic()
             try:
@@ -127,7 +175,7 @@ def closed_loop_worker(
             if status == 200:
                 action = float(body["actions"][0][0])
                 mismatch = action != float(expected[sid])
-                stats.record(200, dt, mismatch=mismatch)
+                stats.record(200, dt, mismatch=mismatch, timing=body.get("timing"))
                 expected[sid] = int(action) + 1
             else:
                 stats.record(status, dt)
@@ -275,12 +323,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     cfg.set_path("gateway.broker.max_sessions", max(1_000_000, 2 * args.sessions))
 
     sink = None
+    telemetry_dir = None
     if args.telemetry_dir:
-        sink = JsonlSink(str(pathlib.Path(args.telemetry_dir) / "telemetry.jsonl"))
+        telemetry_dir = pathlib.Path(args.telemetry_dir)
+        sink = JsonlSink(str(telemetry_dir / "telemetry.jsonl"))
 
     t_setup = time.monotonic()
     print(f"[bench_serve] starting {args.replicas} synthetic replicas ...", flush=True)
-    gw = build_cluster(cfg, sink=sink, start=True)
+    gw = build_cluster(cfg, sink=sink, start=True, telemetry_dir=telemetry_dir)
     manager = gw.manager
     try:
         if len(manager.routable()) < args.replicas:
@@ -353,6 +403,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             sink.close()
 
     snap = stats.snapshot()
+    stages = stats.stage_percentiles()
     unit = f"gateway act p95 ms ({args.sessions} sessions x {args.replicas} replicas)"
     value = round(stats.percentile(0.95), 3)
     best_prior = prior_best_p95(pathlib.Path(args.out_dir), unit)
@@ -382,6 +433,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         "duration_s": round(duration_s, 1),
         "platform": "cpu",
     }
+    if stages:
+        # the trace-context per-stage breakdown: where an acked request's
+        # time went (gateway admission/route/forward/broker_put + replica
+        # batch_queue/jit_step/export). The flattened p95s below are the
+        # fields bench_compare.py gates (lower-is-better, like the headline)
+        record["stages"] = stages
+        for stage in ("forward", "jit_step", "batch_queue"):
+            if stage in stages:
+                record[f"stage_{stage}_p95_ms"] = stages[stage]["p95_ms"]
     if failover:
         record["failover"] = failover
     problems = validate_event(record)
@@ -402,11 +462,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.json:
         print(json.dumps(record, indent=1))
     else:
+        stage_note = ""
+        if stages:
+            stage_note = " | stages p95: " + " ".join(
+                f"{name}={row['p95_ms']}ms" for name, row in stages.items()
+            )
         print(
             f"[bench_serve] {out_path.name}: p50={record['p50_ms']}ms "
             f"p95={record['p95_ms']}ms p99={record['p99_ms']}ms "
             f"shed={record['shed_rate']:.1%} err={record['error_rate']:.2%} "
             f"rps={record['throughput_rps']} acked={record['acked']}"
+            + stage_note
             + (
                 f" | failover: recovery {failover['recovery_s']}s "
                 f"acked_loss={failover['acked_loss']}"

@@ -20,6 +20,7 @@ scalars so annealing never retraces.
 from __future__ import annotations
 
 import os
+import time
 from functools import partial
 from typing import Any, Dict
 
@@ -392,7 +393,9 @@ def main(dist: Distributed, cfg: Config) -> None:
                 rnd = fleet.take_round(policy_step, min_version=fleet.pub_version)
             if rnd is None:
                 break
+            t_merge0 = time.time()
             local, next_value, ep_stats = merge_ppo_round(rnd, fleet.workers)
+            fleet.mark_applied(rnd, t_merge0)
             policy_step += rnd.env_steps
             record_ep_stats(ep_stats)
             with telem.span("Time/train_time"):

@@ -19,6 +19,14 @@ Covered shapes: ``emit({...})`` / ``_emit(telem, {...})`` dict literals and
 the ``rec = {...}`` … ``emit(rec)`` local-alias pattern (linear, per
 function; a ``rec[k] = v`` between binding and emit downgrades the
 missing-field check, not the unknown-key check).
+
+Label-cardinality guard: event names and span names are LABELS — every
+unique name becomes a Prometheus label value (``stage_latency_ms{stage=…}``),
+a stage row in the trace report and a schema key. A dynamically formatted
+name (``f"worker_{i}"``, ``"stage_" + name``, ``"%s" % x``, ``.format(…)``)
+is an unbounded label set, so the rule flags it at ``emit({"event": …})``
+and ``span(…)`` call sites. A plain variable passed through is allowed —
+the binding site is where the literal lives.
 """
 from __future__ import annotations
 
@@ -56,6 +64,31 @@ class TelemetrySchemaRule(Rule):
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.FunctionDef):
                 yield from self._check_function(ctx, node)
+            elif isinstance(node, ast.Call):
+                yield from self._check_span_name(ctx, node)
+
+    def _check_span_name(self, ctx: ModuleContext, call: ast.Call) -> Iterator[Finding]:
+        """span(<dynamically built string>) — each unique span name is a
+        metric key (SpanTracker totals, TraceAnnotation names) and, for
+        trace spans, a Prometheus `stage` label: formatting data into it
+        explodes label cardinality."""
+        fn = call.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", "")
+        if name != "span" or not call.args:
+            return
+        if _dynamic_string(call.args[0]):
+            yield Finding(
+                self.rule_id,
+                str(ctx.path),
+                call.lineno,
+                "non-literal span name (dynamically formatted) — span names are "
+                "metric labels; formatting data into them is a label-cardinality "
+                "explosion",
+                remediation=(
+                    "use a literal span name and carry the varying part as an "
+                    "event field (worker=..., seq=...) instead"
+                ),
+            )
 
     # -- per-function linear walk -----------------------------------------
     def _check_function(self, ctx: ModuleContext, fn: ast.FunctionDef) -> Iterator[Finding]:
@@ -114,6 +147,26 @@ class TelemetrySchemaRule(Rule):
         rec: Optional[ast.Dict] = None
         dirty = False
         for arg in call.args:
+            candidates = [arg] if isinstance(arg, ast.Dict) else []
+            if isinstance(arg, ast.Name) and arg.id in aliases:
+                candidates = [aliases[arg.id][0]]
+            for cand in candidates:
+                if _dynamic_event_value(cand):
+                    # the cardinality guard: f"fault_{kind}" as an event
+                    # name is an unbounded label/schema-key set
+                    yield Finding(
+                        self.rule_id,
+                        str(ctx.path),
+                        call.lineno,
+                        "non-literal event name (dynamically formatted) — event "
+                        "names are schema keys and metric labels; formatting data "
+                        "into them is a label-cardinality explosion",
+                        remediation=(
+                            "use a literal event name and carry the varying part "
+                            "as a declared field (action=..., detail=...)"
+                        ),
+                    )
+                    return
             if isinstance(arg, ast.Dict) and self._event_key(arg) is not None:
                 rec = arg
                 break
@@ -176,3 +229,36 @@ class TelemetrySchemaRule(Rule):
             ):
                 return v.value
         return None
+
+
+def _dynamic_string(node: ast.AST) -> bool:
+    """A string the code BUILDS rather than states: f-strings, ``+``/``%``
+    concatenation involving a string literal, ``"...".format(...)`` and
+    ``str(...)``. A bare Name/attribute passthrough is allowed — the
+    literal lives at its binding site, and flagging every variable would
+    bury the real explosions in noise."""
+    if isinstance(node, ast.JoinedStr):
+        return any(isinstance(v, ast.FormattedValue) for v in node.values)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Mod)):
+        return _contains_str_constant(node)
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "format":
+            return True
+        if isinstance(fn, ast.Name) and fn.id == "str":
+            return True
+    return False
+
+
+def _contains_str_constant(node: ast.AST) -> bool:
+    return any(
+        isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+        for sub in ast.walk(node)
+    )
+
+
+def _dynamic_event_value(node: ast.Dict) -> bool:
+    for k, v in zip(node.keys, node.values):
+        if isinstance(k, ast.Constant) and k.value == "event" and _dynamic_string(v):
+            return True
+    return False

@@ -326,6 +326,24 @@ def doctor(args: Optional[Sequence[str]] = None) -> None:
         raise SystemExit(rc)
 
 
+def trace(args: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl_tpu trace run_dir=<logs/runs/.../version_N> [trace_id=...]
+    [top_k=10] [json=true]` — merged cross-process run timelines
+    (diag/trace.py): discovers every per-process telemetry stream of the
+    run (learner + workers/worker_NNN + replicas/replica_NNN + gateway),
+    skew-corrects them with the clock-handshake offsets, joins spans on
+    trace_id into per-request / per-training-round critical paths, and
+    reports completeness, a per-stage p50/p95 latency table, the top-K
+    slowest traces with stage breakdown, and any on-demand profiler
+    capture dirs."""
+    argv = list(args if args is not None else sys.argv[1:])
+    from .diag.trace import main as trace_main
+
+    rc = trace_main(argv)
+    if rc:
+        raise SystemExit(rc)
+
+
 def lint(args: Optional[Sequence[str]] = None) -> None:
     """`sheeprl_tpu lint [paths...] [--json] [--rule r1,r2] [--list-rules]` —
     the JAX-aware static-analysis pass (analysis/): host-sync, retrace-hazard,
@@ -404,11 +422,11 @@ def available_agents() -> None:
 
 
 def main() -> None:
-    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|gateway|doctor|lint|registration|agents> ...`"""
+    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|gateway|doctor|trace|lint|registration|agents> ...`"""
     argv = sys.argv[1:]
     if argv and argv[0] in (
-        "run", "eval", "evaluation", "resume", "serve", "gateway", "doctor", "lint",
-        "registration", "agents",
+        "run", "eval", "evaluation", "resume", "serve", "gateway", "doctor", "trace",
+        "lint", "registration", "agents",
     ):
         cmd, rest = argv[0], argv[1:]
     else:
@@ -425,6 +443,8 @@ def main() -> None:
         gateway(rest)
     elif cmd == "doctor":
         doctor(rest)
+    elif cmd == "trace":
+        trace(rest)
     elif cmd == "lint":
         lint(rest)
     elif cmd == "registration":

@@ -9,6 +9,10 @@ package *consumes* them:
   :class:`~sheeprl_tpu.diag.findings.Finding`\\ s with remediation hints;
 * :mod:`.doctor` — the ``sheeprl_tpu doctor run_dir=...`` CLI (text and
   ``--json`` reports over stream + resume manifest + checkpoint dir);
+* :mod:`.trace` — the ``sheeprl_tpu trace run_dir=...`` CLI: merges the
+  per-process streams (fleet workers, gateway replicas) with clock-skew
+  correction and reconstructs cross-process request/round critical paths
+  with a per-stage latency table;
 * :mod:`.prometheus` — a lock-light counter/gauge/histogram registry with a
   stdlib-HTTP ``/metrics`` endpoint (Prometheus text format), mirrored from
   the live event stream by the Telemetry facade and reused by the policy
@@ -18,6 +22,7 @@ from .findings import Finding, run_detectors
 from .doctor import diagnose, render_text
 from .prometheus import Counter, Gauge, Histogram, PrometheusServer, Registry, start_http_server
 from .timeline import Timeline, iter_events, rotated_segments
+from .trace import analyze, discover_streams, merge_streams
 
 __all__ = [
     "Counter",
@@ -27,8 +32,11 @@ __all__ = [
     "PrometheusServer",
     "Registry",
     "Timeline",
+    "analyze",
     "diagnose",
+    "discover_streams",
     "iter_events",
+    "merge_streams",
     "render_text",
     "rotated_segments",
     "run_detectors",

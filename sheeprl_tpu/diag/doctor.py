@@ -112,6 +112,24 @@ def diagnose(
             "segments; was the run started with metric.telemetry.jsonl=True?)"
         )
     tl = Timeline.from_path(stream)
+    # per-process streams (fleet workers, gateway replicas, the gateway
+    # itself): fold their events into the same timeline so the trace-aware
+    # detectors (cross_process_stall) see the whole run, not one process
+    process_streams: List[str] = []
+    from .trace import discover_streams
+    from .timeline import iter_events
+
+    for name, sub_path in discover_streams(log_dir):
+        if name == "main":
+            continue
+        try:
+            for rec in iter_events(sub_path, errors=tl.parse_errors):
+                tl.add(rec)
+            process_streams.append(name)
+        except Exception as err:
+            # an unreadable sub-stream must not cost the whole diagnosis,
+            # but it must not vanish silently either
+            tl.parse_errors.append(f"{name}: stream unreadable ({err})")
     findings = run_detectors(tl, cfg)
 
     from ..resilience.resume import read_manifest
@@ -120,6 +138,7 @@ def diagnose(
         "run_dir": str(run_dir),
         "log_dir": str(log_dir),
         "stream_segments": [str(p) for p in segments],
+        "process_streams": process_streams,
         "events": dict(sorted(tl.counts.items())),
         "parse_errors": len(tl.parse_errors),
         "startup": tl.startup,
@@ -199,6 +218,12 @@ def render_text(report: Dict[str, Any]) -> str:
     )
     if len(report.get("stream_segments", [])) > 1:
         lines.append(f"  stream: {len(report['stream_segments'])} rotated segment(s) read in order")
+    if report.get("process_streams"):
+        lines.append(
+            f"  {len(report['process_streams'])} per-process stream(s) merged: "
+            + ", ".join(report["process_streams"])
+            + "  (cross-process paths: `sheeprl_tpu trace run_dir=...`)"
+        )
     if report.get("parse_errors"):
         lines.append(f"  {report['parse_errors']} unparseable line(s) skipped (torn tail?)")
 

@@ -524,6 +524,81 @@ def detect_gateway_shedding(tl: Timeline, cfg: Any = None) -> List[Finding]:
     ]
 
 
+def detect_cross_process_stall(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Cross-process critical paths dominated by WAIT stages (queue /
+    admission / routing) rather than work: the multi-process analogue of
+    overlap_starvation. Built on the merged `trace_span` events — the same
+    joins `sheeprl_tpu trace` reports — so the finding names the exact
+    stage (a fleet worker parked on a full data queue, a request stuck in
+    the replica batcher queue) and where to look next."""
+    from .trace import WAIT_STAGES, _trace_kind, build_traces
+
+    stall_frac = float(_sel(cfg, "diag.trace.stall_frac", 0.5))
+    min_traces = int(_sel(cfg, "diag.trace.min_traces", 8))
+    min_stall_ms = float(_sel(cfg, "diag.trace.min_stall_ms", 1.0))
+    # the same grouping `sheeprl_tpu trace` reports on — the two surfaces
+    # must agree on what a path is
+    by_trace = build_traces(tl.of("trace_span"))
+    considered = 0
+    stalled = 0
+    wait_totals: Dict[str, float] = {}
+    for spans in by_trace.values():
+        if len(spans) < 2:
+            continue  # single-sided: no cross-process path to attribute
+        if _trace_kind(spans) not in ("round", "request"):
+            # publication (publish/param_apply) and other non-path traces
+            # must not dilute the majority test below
+            continue
+        total = sum(float(s.get("dur_ms") or 0.0) for s in spans)
+        wait = sum(
+            float(s.get("dur_ms") or 0.0) for s in spans if s.get("name") in WAIT_STAGES
+        )
+        if total <= 0:
+            continue
+        considered += 1
+        if wait >= min_stall_ms and wait / total >= stall_frac:
+            stalled += 1
+            for s in spans:
+                if s.get("name") in WAIT_STAGES:
+                    key = f"{s.get('role')}/{s.get('name')}"
+                    wait_totals[key] = wait_totals.get(key, 0.0) + float(s.get("dur_ms") or 0.0)
+    if stalled < min_traces or considered == 0 or stalled / considered < 0.5:
+        return []
+    worst_stage, worst_ms = max(wait_totals.items(), key=lambda kv: kv[1])
+    return [
+        Finding(
+            code="cross_process_stall",
+            severity="warning",
+            title=(
+                f"cross-process stall: {stalled}/{considered} traced paths spend "
+                f">= {stall_frac:.0%} of their time waiting (worst stage: {worst_stage})"
+            ),
+            detail=(
+                f"Wait stages (queue/admission/routing) dominate the reconstructed "
+                f"critical paths; '{worst_stage}' alone accounts for {worst_ms:.0f} ms "
+                f"across the stalled traces. Run `sheeprl_tpu trace run_dir=...` for "
+                f"the per-stage p50/p95 table and the top slowest traces."
+            ),
+            remediation=(
+                "worker/queue_wait dominating means the learner is the bottleneck "
+                "(raise fleet.queue_depth, shrink the train burst, or add learner "
+                "throughput); replica/batch_queue means the serving fleet is "
+                "under-provisioned (add gateway.replicas or widen the batch "
+                "buckets); gateway/admission means offered load exceeds admission "
+                "limits (scale out or raise gateway.admission.*). Capture a device "
+                "view of the slow side with POST /admin/profile (replicas) or the "
+                "fleet profile ctrl op."
+            ),
+            data={
+                "stalled": stalled,
+                "considered": considered,
+                "stall_frac": stall_frac,
+                "wait_ms_by_stage": {k: round(v, 2) for k, v in sorted(wait_totals.items())},
+            },
+        )
+    ]
+
+
 def detect_incomplete_stream(tl: Timeline, cfg: Any = None) -> List[Finding]:
     """No shutdown event: the process died without closing telemetry — a
     crash, OOM-kill or external SIGKILL (a clean preemption still writes
@@ -566,6 +641,7 @@ DETECTORS: List[Callable[[Timeline, Any], List[Finding]]] = [
     detect_quarantine,
     detect_replica_flap,
     detect_gateway_shedding,
+    detect_cross_process_stall,
     detect_incomplete_stream,
 ]
 

@@ -107,13 +107,26 @@ class Histogram:
     """Fixed-bucket histogram with cumulative rendering and host-side
     percentile estimation (linear interpolation inside the winning bucket —
     exact enough for p50/p95/p99 dashboards; the raw buckets are what
-    Prometheus itself aggregates)."""
+    Prometheus itself aggregates).
+
+    ``labels`` makes this one labeled CHILD of a metric family: several
+    histograms share a name (one TYPE/HELP block) and differ only in their
+    label set — e.g. ``stage_latency_ms{role="worker",stage="env_step"}``.
+    Label values must come from a closed set (the lint rule rejects
+    dynamically formatted span/event names for exactly this reason)."""
 
     kind = "histogram"
 
-    def __init__(self, name: str, help: str = "", buckets: Sequence[float] = SECONDS_BUCKETS) -> None:
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Sequence[float] = SECONDS_BUCKETS,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> None:
         self.name = name
         self.help = help
+        self.labels = dict(labels or {})
         self.buckets = tuple(sorted(float(b) for b in buckets))
         if not self.buckets:
             raise ValueError(f"histogram {name} needs at least one bucket bound")
@@ -121,6 +134,12 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)  # +1 for +Inf
         self._sum = 0.0
         self._count = 0
+
+    def _label_str(self, extra: str = "") -> str:
+        parts = [f'{k}="{v}"' for k, v in sorted(self.labels.items())]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -169,10 +188,12 @@ class Histogram:
         cum = 0
         for bound, c in zip(self.buckets, counts):
             cum += c
-            out.append((f'{self.name}_bucket{{le="{_fmt(bound)}"}}', cum))
-        out.append((f'{self.name}_bucket{{le="+Inf"}}', total))
-        out.append((f"{self.name}_sum", total_sum))
-        out.append((f"{self.name}_count", total))
+            le = 'le="' + _fmt(bound) + '"'
+            out.append((f"{self.name}_bucket{self._label_str(le)}", cum))
+        inf = 'le="+Inf"'
+        out.append((f"{self.name}_bucket{self._label_str(inf)}", total))
+        out.append((f"{self.name}_sum{self._label_str()}", total_sum))
+        out.append((f"{self.name}_count{self._label_str()}", total))
         return out
 
 
@@ -188,15 +209,20 @@ class Registry:
         self._lock = threading.Lock()  # guards the name→metric map only
         self._metrics: Dict[str, Any] = {}
 
-    def _get(self, cls: Any, name: str, help: str, **kw: Any) -> Any:
+    def _get(self, cls: Any, name: str, help: str, labels: Optional[Dict[str, str]] = None, **kw: Any) -> Any:
         name = f"{self.prefix}_{name}" if self.prefix and not name.startswith(self.prefix) else name
+        # labeled children share the family name; the registry key carries
+        # the label set so each child accumulates independently
+        key = name
+        if labels:
+            key += "{" + ",".join(f'{k}="{v}"' for k, v in sorted(labels.items())) + "}"
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is None:
-                m = cls(name, help, **kw)
-                self._metrics[name] = m
+                m = cls(name, help, **(dict(kw, labels=labels) if labels else kw))
+                self._metrics[key] = m
             elif not isinstance(m, cls):
-                raise TypeError(f"metric {name} already registered as {type(m).__name__}")
+                raise TypeError(f"metric {key} already registered as {type(m).__name__}")
             return m
 
     def counter(self, name: str, help: str = "") -> Counter:
@@ -205,21 +231,35 @@ class Registry:
     def gauge(self, name: str, help: str = "") -> Gauge:
         return self._get(Gauge, name, help)
 
-    def histogram(self, name: str, help: str = "", buckets: Sequence[float] = SECONDS_BUCKETS) -> Histogram:
-        return self._get(Histogram, name, help, buckets=buckets)
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Sequence[float] = SECONDS_BUCKETS,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> Histogram:
+        return self._get(Histogram, name, help, labels=labels, buckets=buckets)
 
     def metrics(self) -> Iterable[Any]:
         with self._lock:
             return list(self._metrics.values())
 
     def render(self) -> str:
-        lines: List[str] = []
+        # group by family: labeled children share a name and the text
+        # format wants one TYPE/HELP block with all the family's samples
+        # together, regardless of child creation order
+        families: Dict[str, List[Any]] = {}
         for m in self.metrics():
-            if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
-            for sample_name, value in m.samples():
-                lines.append(f"{sample_name} {_fmt(value)}")
+            families.setdefault(m.name, []).append(m)
+        lines: List[str] = []
+        for name, members in families.items():
+            head = members[0]
+            if head.help:
+                lines.append(f"# HELP {name} {head.help}")
+            lines.append(f"# TYPE {name} {head.kind}")
+            for m in members:
+                for sample_name, value in m.samples():
+                    lines.append(f"{sample_name} {_fmt(value)}")
         return "\n".join(lines) + "\n"
 
     # -- the JSONL bridge ---------------------------------------------------
@@ -321,6 +361,20 @@ class Registry:
             self.counter(
                 f"preempt_{rec.get('action', 'requested')}_total", "preemption lifecycle events"
             ).inc()
+        elif event == "trace_span":
+            # per-stage critical-path latency, labeled by role and stage —
+            # the live mirror of what `sheeprl_tpu trace` reports post-hoc.
+            # Label values are bounded: span names are literal at every
+            # emit site (telemetry-schema-drift enforces it)
+            self.histogram(
+                "stage_latency_ms",
+                "distributed-trace stage latency (ms) by role/stage",
+                LATENCY_MS_BUCKETS,
+                labels={
+                    "role": str(rec.get("role") or "unknown"),
+                    "stage": str(rec.get("name") or "unknown"),
+                },
+            ).observe(float(rec.get("dur_ms") or 0.0))
         elif event == "shutdown":
             self.gauge("up", "1 while the run is alive").set(0.0)
         elif event == "rotate":

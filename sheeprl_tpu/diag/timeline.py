@@ -155,7 +155,10 @@ class Timeline:
         event = str(rec.get("event"))
         self.counts[event] = self.counts.get(event, 0) + 1
         step = rec.get("step")
-        if isinstance(step, (int, float)) and not isinstance(step, bool):
+        # trace_span steps are per-process production counters (a fleet
+        # worker's lifetime count runs AHEAD of the learner's acked step),
+        # not the run's policy-step axis — they must not move the high-water
+        if event != "trace_span" and isinstance(step, (int, float)) and not isinstance(step, bool):
             self._last_step = max(self._last_step, int(step))
         if event == "log":
             rec = _slim_log(rec)

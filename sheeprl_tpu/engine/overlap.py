@@ -107,7 +107,17 @@ class SpscRing:
 class Packet:
     """One env-interaction slice crossing the player→learner queue."""
 
-    __slots__ = ("payload", "env_steps", "version", "staleness", "produced_t", "produced_step")
+    __slots__ = (
+        "payload",
+        "env_steps",
+        "version",
+        "staleness",
+        "produced_t",
+        "produced_step",
+        "produced_wall",
+        "trace_id",
+        "span_id",
+    )
 
     def __init__(self, payload: Any, env_steps: int):
         self.payload = payload
@@ -116,6 +126,13 @@ class Packet:
         self.staleness = 0  # bursts in flight at production time (≤ bound)
         self.produced_t = 0.0
         self.produced_step = 0  # player env-step counter AFTER this slice
+        self.produced_wall = 0.0  # wall clock at production (trace axis)
+        # distributed-trace identity: the player stamps a fresh trace per
+        # packet; the learner's take/apply spans join it, so one packet's
+        # env-step → queue → apply path is reconstructable cross-thread
+        # exactly like a fleet packet's is cross-process
+        self.trace_id = ""
+        self.span_id = ""
 
     # -- replay-buffer op payloads ----------------------------------------
     def apply(self, rb: Any, aggregator: Any = None) -> None:
@@ -221,6 +238,7 @@ class OverlapEngine:
         initial_step: int = 0,
         telem: Any = None,
         guard: Any = None,
+        trace_spans: bool = True,
     ) -> None:
         self.enabled = bool(enabled)
         self.queue_depth = max(1, int(queue_depth))
@@ -237,6 +255,7 @@ class OverlapEngine:
         self.initial_step = int(initial_step)
         self.telem = telem
         self.guard = guard
+        self.trace_spans = bool(trace_spans) and telem is not None
 
         self._ring = SpscRing(self.queue_depth)
         self._stop = threading.Event()
@@ -288,6 +307,7 @@ class OverlapEngine:
             initial_step=initial_step,
             telem=telem,
             guard=guard,
+            trace_spans=bool(sel("metric.telemetry.trace_spans", True)),
         )
 
     # -- lifecycle ---------------------------------------------------------
@@ -336,6 +356,7 @@ class OverlapEngine:
                     break
 
                 t0 = time.perf_counter()
+                t0_wall = time.time()
                 pkt = play_fn()
                 busy_s = time.perf_counter() - t0
                 if pkt is None:
@@ -343,9 +364,31 @@ class OverlapEngine:
                 pkt.version = self._pub_seq
                 pkt.staleness = self._burst_seq - self._pub_seq
                 pkt.produced_t = time.perf_counter()
+                pkt.produced_wall = time.time()
                 # step-id stamp: the player's env-step counter once this
                 # slice lands — diag correlates player/learner spans with it
                 pkt.produced_step = self.produced_steps + pkt.env_steps
+                if self.trace_spans:
+                    # the packet's trace identity: the learner's take span
+                    # joins it, same contract as a fleet packet's frame
+                    from ..telemetry import tracing
+
+                    pkt.trace_id = tracing.new_trace_id()
+                    pkt.span_id = tracing.new_span_id()
+                    try:
+                        self.telem.emit(
+                            tracing.span_record(
+                                "env_step",
+                                "player",
+                                tracing.TraceContext(pkt.trace_id, pkt.span_id),
+                                t0_wall,
+                                pkt.produced_wall,
+                                step=pkt.produced_step,
+                                version=pkt.version,
+                            )
+                        )
+                    except Exception:
+                        pass
 
                 t0 = time.perf_counter()
                 # sole producer + pre-checked free slot: effectively
@@ -411,8 +454,27 @@ class OverlapEngine:
             raise RuntimeError("overlap player thread crashed") from self._player_exc
         with self._stats_lock:
             self._learner_stall_s += stalled
+        now_wall = time.time()
         for pkt in out:
             self.acked_steps += pkt.env_steps
+            if self.trace_spans and pkt.trace_id:
+                # queue transit: production → learner pickup, continuing the
+                # packet's trace (the fleet twin is the worker's queue_wait)
+                from ..telemetry import tracing
+
+                try:
+                    self.telem.emit(
+                        tracing.span_record(
+                            "queue_wait",
+                            "learner",
+                            tracing.TraceContext(pkt.trace_id, tracing.new_span_id(), pkt.span_id),
+                            pkt.produced_wall,
+                            now_wall,
+                            step=self.acked_steps,
+                        )
+                    )
+                except Exception:
+                    pass
         self.maybe_emit()
         return out
 

@@ -77,6 +77,7 @@ class FleetEngine:
         seed: int = 0,
         telem: Any = None,
         guard: Any = None,
+        trace_spans: bool = True,
     ) -> None:
         self.enabled = bool(enabled) and int(workers) > 0
         self.workers = int(workers)
@@ -95,6 +96,7 @@ class FleetEngine:
         self.telem = telem
         self.guard = guard
         self.seed = int(seed)
+        self.trace_spans = bool(trace_spans)
 
         self.sup: Optional[FleetSupervisor] = None
         self.num_envs = 0
@@ -168,6 +170,7 @@ class FleetEngine:
             seed=int(opt("seed", 0)),
             telem=telem,
             guard=guard,
+            trace_spans=bool(opt("metric.telemetry.trace_spans", True)),
         )
 
     # -- lifecycle ---------------------------------------------------------
@@ -197,6 +200,16 @@ class FleetEngine:
             fail_window_s=self.fail_window_s,
             worker_platform=self.worker_platform,
             seed=self.seed,
+            # workers write their own telemetry streams under the run dir
+            # (workers/worker_NNN/); the facade's log_dir is that root —
+            # only when telemetry is on at all, so a metrics-off run never
+            # grows stream dirs
+            log_dir=(
+                getattr(self.telem, "log_dir", None)
+                if getattr(self.telem, "enabled", False)
+                else None
+            ),
+            trace=self.trace_spans,
         )
         self.sup.progress_step = self.acked_steps  # resume: seed lifetimes
         self.sup.start()
@@ -347,6 +360,49 @@ class FleetEngine:
             self._stats_round_wait_s += time.perf_counter() - t0
             self.maybe_emit(step)
 
+    def mark_applied(self, rnd: FleetRound, t_start: Optional[float] = None) -> None:
+        """Emit the learner-side apply spans for a round merged OUTSIDE the
+        engine's own apply modes (PPO's `merge_ppo_round`): same trace join
+        as apply_concat/apply_sliced, caller-timed."""
+        t1 = time.time()
+        self._emit_apply_spans(rnd, t1 if t_start is None else float(t_start), t1)
+
+    def request_profile(self, worker_id: int, duration_s: float = 2.0) -> bool:
+        """Remotely open a windowed ``jax.profiler`` capture inside one
+        worker (ctrl-queue op; the capture dir lands in the worker's stream
+        dir and the trace report links it)."""
+        if not self.enabled or self.sup is None:
+            return False
+        return self.sup.request_profile(worker_id, duration_s)
+
+    def _emit_apply_spans(self, rnd: FleetRound, t0: float, t1: float) -> None:
+        """One `learner_apply` span per packet, continuing the trace the
+        worker's `env_step` span opened (the packet carries its ids). The
+        whole-round apply interval is attributed to each packet — per-packet
+        sub-timing inside one concatenated buffer add doesn't exist."""
+        if not self.trace_spans or self.telem is None:
+            return
+        from ..telemetry import tracing
+
+        for p in rnd.packets:
+            if not p.trace or not p.trace[0]:
+                continue
+            try:
+                self.telem.emit(
+                    tracing.span_record(
+                        "learner_apply",
+                        "learner",
+                        tracing.TraceContext(p.trace[0], tracing.new_span_id(), p.trace[1]),
+                        t0,
+                        t1,
+                        worker=p.worker_id,
+                        seq=p.seq,
+                        step=self.acked_steps,
+                    )
+                )
+            except Exception:
+                pass
+
     # -- apply modes -------------------------------------------------------
     def _column_blocks(self, rnd: FleetRound, op_idx: int) -> List[Dict[str, np.ndarray]]:
         """Per-worker-slot data blocks for one op position, quarantined slots
@@ -367,6 +423,7 @@ class FleetEngine:
     ) -> int:
         """Merge a round into one full-width add per op (fixed-width
         `ReplayBuffer` layouts — the SAC family)."""
+        t_apply0 = time.time()
         op_counts = {len(p.payload.ops) for p in rnd.packets}
         if len(op_counts) != 1:
             raise RuntimeError(
@@ -390,11 +447,13 @@ class FleetEngine:
             for p in rnd.packets:
                 for key, value in p.payload.stats:
                     aggregator.update(key, value)
+        self._emit_apply_spans(rnd, t_apply0, time.time())
         return rnd.env_steps
 
     def apply_sliced(self, rnd: FleetRound, rb: Any, aggregator: Any = None, validate: bool = False) -> int:
         """Replay each worker's ops against its own global env columns
         (per-env sub-buffer layouts — the Dreamer family)."""
+        t_apply0 = time.time()
         epw = self.envs_per_worker
         for p in rnd.packets:
             off = p.worker_id * epw
@@ -411,6 +470,7 @@ class FleetEngine:
             if aggregator is not None:
                 for key, value in p.payload.stats:
                     aggregator.update(key, value)
+        self._emit_apply_spans(rnd, t_apply0, time.time())
         return rnd.env_steps
 
     # -- telemetry ---------------------------------------------------------

@@ -34,7 +34,9 @@ import zlib
 from typing import Any, List, NamedTuple, Optional, Tuple
 
 __all__ = [
+    "CTRL_CLOCK",
     "CTRL_PARAMS",
+    "CTRL_PROFILE",
     "CTRL_STOP",
     "FleetPacket",
     "TornPacketError",
@@ -45,12 +47,22 @@ __all__ = [
 
 CTRL_PARAMS = "params"
 CTRL_STOP = "stop"
+# clock-offset handshake probe: ("clock", t_send) — the worker answers by
+# emitting a `clock` event on its own telemetry stream (tracing.clock_record)
+CTRL_CLOCK = "clock"
+# on-demand windowed profiler capture: ("profile", duration_s) — the worker
+# opens a jax.profiler window into its stream dir (RemoteProfiler)
+CTRL_PROFILE = "profile"
 
 
 class FleetPacket(NamedTuple):
     """One decoded transition packet: ``payload`` is whatever the worker's
     program produced for one interaction slice (a ``RecordingSink`` for the
-    step-based algorithms, a rollout tuple for PPO)."""
+    step-based algorithms, a rollout tuple for PPO). ``trace`` is the
+    ``(trace_id, span_id)`` the worker stamped on the slice's ``env_step``
+    span — it rides the frame so the learner's apply span joins the same
+    trace and `sheeprl_tpu trace` can reconstruct the cross-process round
+    path (worker env step → queue wait → learner apply)."""
 
     worker_id: int
     incarnation: int
@@ -59,6 +71,7 @@ class FleetPacket(NamedTuple):
     version: int  # param publication version the worker acted with
     payload: Any
     stats: Tuple[Tuple[str, float], ...] = ()
+    trace: Tuple[str, str] = ("", "")  # (trace_id, producing span_id)
 
 
 class TornPacketError(RuntimeError):
@@ -66,10 +79,10 @@ class TornPacketError(RuntimeError):
 
 
 def encode_packet(pkt: FleetPacket) -> Tuple[int, int, int, int, int, int, bytes]:
-    """Frame a packet: the payload (+stats) is pickled once here; the scalar
-    header stays outside the blob so the learner can account a torn packet
-    to the right worker without trusting the corrupted bytes."""
-    blob = pickle.dumps((pkt.payload, pkt.stats), protocol=pickle.HIGHEST_PROTOCOL)
+    """Frame a packet: the payload (+stats+trace) is pickled once here; the
+    scalar header stays outside the blob so the learner can account a torn
+    packet to the right worker without trusting the corrupted bytes."""
+    blob = pickle.dumps((pkt.payload, pkt.stats, pkt.trace), protocol=pickle.HIGHEST_PROTOCOL)
     return (
         int(pkt.worker_id),
         int(pkt.incarnation),
@@ -93,11 +106,20 @@ def decode_packet(frame: Any) -> FleetPacket:
             f"worker {worker_id} packet seq={seq}: CRC mismatch ({len(blob)} bytes)"
         )
     try:
-        payload, stats = pickle.loads(blob)
+        obj = pickle.loads(blob)
+        payload, stats = obj[0], obj[1]
+        trace = tuple(obj[2]) if len(obj) > 2 else ("", "")
     except Exception as err:  # corrupted in a way the CRC happened to pass
         raise TornPacketError(f"worker {worker_id} packet seq={seq}: {err!r}") from err
     return FleetPacket(
-        int(worker_id), int(incarnation), int(seq), int(env_steps), int(version), payload, stats
+        int(worker_id),
+        int(incarnation),
+        int(seq),
+        int(env_steps),
+        int(version),
+        payload,
+        stats,
+        trace,
     )
 
 

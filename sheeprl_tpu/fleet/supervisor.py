@@ -40,7 +40,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..resilience.chaos import chaos_from_cfg
 from ..resilience.supervisor import HeartbeatWatchdog
-from .protocol import CTRL_PARAMS, CTRL_STOP, WorkerChannel
+from ..telemetry import tracing
+from .protocol import CTRL_CLOCK, CTRL_PARAMS, CTRL_PROFILE, CTRL_STOP, WorkerChannel
 from .worker import worker_entry
 
 __all__ = ["FleetSupervisor", "WorkerHandle"]
@@ -65,6 +66,7 @@ class WorkerHandle:
         self.watchdog: Optional[HeartbeatWatchdog] = None
         self.incarnation = 0
         self.state = "new"  # new | running | backoff | quarantined | stopped
+        self.clock_probed = False  # one handshake per incarnation, post-startup
         self.spawned_at = 0.0
         self.fails: deque = deque()  # (monotonic_t, reason)
         self.respawn_at = 0.0
@@ -100,9 +102,13 @@ class FleetSupervisor:
         fail_window_s: float = 300.0,
         worker_platform: str = "cpu",
         seed: int = 0,
+        log_dir: Optional[str] = None,
+        trace: bool = True,
     ):
         self.cfg = cfg
         self.telem = telem
+        self.log_dir = str(log_dir) if log_dir else None
+        self.trace = bool(trace)
         self.program = str(program)
         self.num_workers = int(num_workers)
         self.queue_depth = int(queue_depth)
@@ -149,6 +155,8 @@ class FleetSupervisor:
             "num_workers": self.num_workers,
             "incarnation": handle.incarnation,
             "initial_lifetime": self.progress_step // self.num_workers,
+            "log_dir": self.log_dir,  # the worker's own telemetry stream root
+            "trace": self.trace,
         }
         # the child inherits os.environ at exec: pin its backend BEFORE the
         # interpreter starts so `import jax` in the child never touches the
@@ -171,6 +179,7 @@ class FleetSupervisor:
                 os.environ["JAX_PLATFORMS"] = saved
         handle.state = "running"
         handle.hung_stall = None
+        handle.clock_probed = False
         handle.spawned_at = time.monotonic()
         if handle.watchdog is None:
             handle.watchdog = HeartbeatWatchdog(
@@ -195,7 +204,7 @@ class FleetSupervisor:
         # a respawned worker starts acting with the newest snapshot at once
         if self._last_params is not None:
             try:
-                handle.channel.ctrl.put((CTRL_PARAMS, self._last_params[0], self._last_params[1]))
+                handle.channel.ctrl.put((CTRL_PARAMS,) + self._last_params)
             except Exception:
                 pass
 
@@ -213,10 +222,17 @@ class FleetSupervisor:
         The snapshot is pickled ONCE here and the same bytes blob is put on
         every ctrl queue — N queue feeders re-pickling a multi-MB pytree
         independently would tax the learner host N× per train burst; a
-        bytes put is a memcpy. Workers unpickle on receipt."""
+        bytes put is a memcpy. Workers unpickle on receipt.
+
+        Each publication carries its wall-clock send time and a fresh trace
+        id: the learner emits the `publish` span, every worker emits a
+        `param_apply` span in the same trace — their pairing is the
+        per-worker param-apply lag the trace report surfaces."""
         self.pub_seq += 1
+        t_pub = time.time()
+        pub_trace = tracing.new_trace_id()
         blob = pickle.dumps(params_np, protocol=pickle.HIGHEST_PROTOCOL)
-        self._last_params = (self.pub_seq, blob)
+        self._last_params = (self.pub_seq, blob, t_pub, pub_trace)
         for handle in self.handles:
             if handle.state != "running" or handle.channel is None:
                 continue
@@ -232,9 +248,21 @@ class FleetSupervisor:
                 )
                 continue
             try:
-                handle.channel.ctrl.put((CTRL_PARAMS, self.pub_seq, blob))
+                handle.channel.ctrl.put((CTRL_PARAMS,) + self._last_params)
             except Exception:
                 pass  # a dying worker's queue: the monitor will catch it
+        if self.trace:
+            _emit(
+                self.telem,
+                tracing.span_record(
+                    "publish",
+                    "learner",
+                    tracing.TraceContext(pub_trace, tracing.new_span_id()),
+                    t_pub,
+                    time.time(),
+                    version=self.pub_seq,
+                ),
+            )
         return self.pub_seq
 
     def resend_params(self, worker_id: int, step: int = 0) -> None:
@@ -259,9 +287,34 @@ class FleetSupervisor:
             },
         )
         try:
-            handle.channel.ctrl.put((CTRL_PARAMS, self._last_params[0], self._last_params[1]))
+            handle.channel.ctrl.put((CTRL_PARAMS,) + self._last_params)
         except Exception:
             pass
+
+    def request_profile(self, worker_id: int, duration_s: float = 2.0) -> bool:
+        """Trigger a windowed ``jax.profiler`` capture inside one worker
+        process — the fleet half of the on-demand profiling control plane
+        (the serving half is the replica's ``POST /admin/profile``). The
+        capture dir lands in the worker's stream dir and is announced there
+        as a ``trace`` event, so `sheeprl_tpu trace` links it."""
+        handle = self.handles[int(worker_id)]
+        if handle.state != "running" or handle.channel is None:
+            return False
+        try:
+            handle.channel.ctrl.put((CTRL_PROFILE, float(duration_s)))
+        except Exception:
+            return False
+        _emit(
+            self.telem,
+            {
+                "event": "fleet",
+                "action": "profile",
+                "step": 0,
+                "worker": handle.worker_id,
+                "detail": f"windowed capture requested ({duration_s:.1f}s)",
+            },
+        )
+        return True
 
     # -- monitoring --------------------------------------------------------
     def monitor(self, step: int = 0) -> None:
@@ -297,6 +350,17 @@ class FleetSupervisor:
                                 ),
                             )
                         continue
+                    if not handle.clock_probed:
+                        # clock-offset handshake, sent only once the worker's
+                        # loop is demonstrably running (first heartbeat): a
+                        # probe queued at spawn would measure interpreter +
+                        # jax startup as "skew". The worker answers with a
+                        # `clock` event on its OWN stream.
+                        handle.clock_probed = True
+                        try:
+                            handle.channel.ctrl.put((CTRL_CLOCK, time.time()))
+                        except Exception:
+                            pass
                     handle.watchdog.beat(hb)
                     if handle.hung_stall is not None:
                         hb_at_stall, stalled_s = handle.hung_stall

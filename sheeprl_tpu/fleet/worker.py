@@ -16,7 +16,18 @@ A worker owns:
   child so the spawn args stay picklable;
 * the newest **param snapshot** pushed by the learner over the ctrl queue
   (versions may be skipped — the worker always drains to the latest);
+* its **own telemetry stream** — ``workers/worker_NNN/telemetry.jsonl``
+  under the run dir (role/pid/incarnation stamped in the startup
+  heartbeat). Every slice writes an ``env_step`` + ``queue_wait``
+  ``trace_span`` pair whose ``(trace_id, span_id)`` also rides the packet
+  frame, so the learner's apply span lands in the SAME trace and
+  `sheeprl_tpu trace` can reconstruct the worker→learner critical path;
 * an optional :class:`~sheeprl_tpu.resilience.chaos.ChaosInjector`.
+
+Control-plane ops beyond params/stop: ``CTRL_CLOCK`` (the clock-offset
+handshake — answered with a ``clock`` event on the worker's stream) and
+``CTRL_PROFILE`` (a windowed on-demand ``jax.profiler`` capture into the
+worker's stream dir, closed by a per-slice deadline poll).
 
 The loop is intentionally boring: drain ctrl → maybe inject chaos → run one
 interaction slice into a ``RecordingSink`` → frame + CRC → put (stamping
@@ -35,7 +46,15 @@ import time
 import traceback
 from typing import Any, Dict, Optional
 
-from .protocol import CTRL_PARAMS, CTRL_STOP, FleetPacket, WorkerChannel, encode_packet
+from .protocol import (
+    CTRL_CLOCK,
+    CTRL_PARAMS,
+    CTRL_PROFILE,
+    CTRL_STOP,
+    FleetPacket,
+    WorkerChannel,
+    encode_packet,
+)
 
 __all__ = ["fleet_worker_loop", "worker_entry"]
 
@@ -56,11 +75,14 @@ def fleet_worker_loop(
     chaos: Optional[Any],
     worker_id: int,
     incarnation: int,
+    sink: Any = None,
+    profiler: Any = None,
 ) -> None:
     """The worker hot loop (scanned by ``scripts/check_host_sync.py`` — keep
     it free of hidden device syncs; the program's jitted act is the only
     device interaction and its outputs are consumed as numpy by the env)."""
     from ..engine import RecordingSink
+    from ..telemetry import tracing
 
     heartbeat = 0
     seq = 0
@@ -78,6 +100,13 @@ def fleet_worker_loop(
         heartbeat += 1
         channel.heartbeat.value = heartbeat
 
+    def _trace_emit(rec: Dict[str, Any]) -> None:
+        if sink is not None:
+            try:
+                sink.write(rec)
+            except Exception:
+                pass
+
     program.beat = _beat
     while not channel.stop.is_set():
         # ---- control: drain to the newest publication --------------------
@@ -91,12 +120,37 @@ def fleet_worker_loop(
                 return
             if msg[0] == CTRL_PARAMS:
                 latest = msg
+            elif msg[0] == CTRL_CLOCK:
+                # the handshake answer lives on THIS worker's stream: the
+                # merger reads each stream's own clock events
+                _trace_emit(tracing.clock_record(msg[1], role="worker", worker=worker_id))
+            elif msg[0] == CTRL_PROFILE and profiler is not None:
+                profiler.start(msg[1] if len(msg) > 1 else 2.0)
         if latest is not None:
             # publications arrive as a shared pickle blob (dumped once
             # learner-side for the whole fleet); only the newest is decoded
             program.set_params(pickle.loads(latest[2]), int(latest[1]))
             version = int(latest[1])
             channel.param_version.value = version
+            # param-apply lag: publish wall time → APPLIED wall time (the
+            # span ends after unpickle+set_params — transport plus the
+            # apply cost itself). The publication carries its own trace id,
+            # so publish (learner stream) and param_apply (every worker
+            # stream) join one trace.
+            if len(latest) > 3 and latest[3] is not None:
+                _trace_emit(
+                    tracing.span_record(
+                        "param_apply",
+                        "worker",
+                        tracing.child_context((str(latest[4]), "") if len(latest) > 4 else None),
+                        latest[3],
+                        time.time(),
+                        version=version,
+                        worker=worker_id,
+                    )
+                )
+        if profiler is not None:
+            profiler.poll()  # close an elapsed on-demand capture window
         if sync_mode and version <= used_version:
             # strict on-policy mode: one slice per publication — park until
             # the learner publishes the next params (or stops)
@@ -111,12 +165,24 @@ def fleet_worker_loop(
 
         # ---- one interaction slice ---------------------------------------
         _beat()  # the slice gets the full fleet.hang_s budget from HERE
-        sink = RecordingSink()
-        env_steps, payload = program.step(sink)
+        sink_rec = RecordingSink()
+        t_step0 = time.time()
+        env_steps, payload = program.step(sink_rec)
+        t_step1 = time.time()
         if payload is None:
-            payload = sink
+            payload = sink_rec
         used_version = version
-        pkt = FleetPacket(worker_id, incarnation, seq, int(env_steps), version, payload)
+        ctx = tracing.TraceContext(tracing.new_trace_id(), tracing.new_span_id())
+        _trace_emit(
+            tracing.span_record(
+                "env_step", "worker", ctx, t_step0, t_step1,
+                worker=worker_id, seq=seq, version=version, step=lifetime_steps,
+            )
+        )
+        pkt = FleetPacket(
+            worker_id, incarnation, seq, int(env_steps), version, payload,
+            trace=(ctx.trace_id, ctx.span_id),
+        )
         frame = encode_packet(pkt)
         if chaos is not None:
             frame = frame[:-1] + (chaos.corrupt(frame[-1], seq),)
@@ -130,6 +196,21 @@ def fleet_worker_loop(
                 break
             except _q.Full:
                 continue
+        t_put = time.time()
+        # queue_wait: slice done → frame accepted by the bounded queue. Under
+        # backpressure this is where a worker's time goes — exactly the stage
+        # the cross_process_stall finding attributes.
+        _trace_emit(
+            tracing.span_record(
+                "queue_wait",
+                "worker",
+                tracing.TraceContext(ctx.trace_id, tracing.new_span_id(), ctx.span_id),
+                t_step1,
+                t_put,
+                worker=worker_id,
+                seq=seq,
+            )
+        )
         seq += 1
         lifetime_steps += int(env_steps)
         heartbeat += 1
@@ -138,15 +219,28 @@ def fleet_worker_loop(
 
 def worker_entry(spec: Dict[str, Any], channel: WorkerChannel, chaos: Optional[Any]) -> None:
     """Process entrypoint (spawn target). ``spec`` is a plain dict:
-    ``{program, cfg, worker_id, num_workers, incarnation}``."""
+    ``{program, cfg, worker_id, num_workers, incarnation, log_dir?, trace?}``."""
     worker_id = int(spec["worker_id"])
     incarnation = int(spec["incarnation"])
+    sink = None
+    profiler = None
     try:
         # tame the child's footprint before jax initializes: workers are
         # numpy/env-bound, a thread pool per worker just thrashes the host
         os.environ.setdefault("XLA_FLAGS", "--xla_cpu_multi_thread_eigen=false")
         from ..config import Config
 
+        if spec.get("log_dir") and spec.get("trace", True):
+            from ..telemetry.tracing import RemoteProfiler, open_process_stream
+
+            sink = open_process_stream(
+                spec["log_dir"], "worker", worker_id, incarnation=incarnation
+            )
+            profiler = RemoteProfiler(
+                os.path.join(os.path.dirname(sink.path), "xprof"),
+                emit=sink.write,
+                role="worker",
+            )
         cfg = Config(spec["cfg"])
         program = _resolve_program(str(spec["program"]))(
             cfg, worker_id, int(spec["num_workers"])
@@ -158,7 +252,7 @@ def worker_entry(spec: Dict[str, Any], channel: WorkerChannel, chaos: Optional[A
             program.lifetime = int(spec.get("initial_lifetime", 0))
         if chaos is not None:
             chaos.incarnation = incarnation
-        fleet_worker_loop(program, channel, chaos, worker_id, incarnation)
+        fleet_worker_loop(program, channel, chaos, worker_id, incarnation, sink, profiler)
         rc = 0
     except KeyboardInterrupt:
         rc = 0
@@ -171,6 +265,16 @@ def worker_entry(spec: Dict[str, Any], channel: WorkerChannel, chaos: Optional[A
         )
         rc = 1
     finally:
+        if profiler is not None:
+            try:
+                profiler.stop()
+            except Exception:
+                pass
+        if sink is not None:
+            try:
+                sink.close()
+            except Exception:
+                pass
         try:
             channel.close()
         except Exception:

@@ -22,15 +22,22 @@ def build_cluster(
     ckpt_path: Optional[Any] = None,
     sink: Any = None,
     start: bool = True,
+    telemetry_dir: Optional[Any] = None,
 ) -> Gateway:
     """Build (and optionally start) the full serving cluster from the
     ``gateway`` config group. With ``ckpt_path`` the replicas serve the real
     checkpoint (the run's saved config rides into each replica process);
     without it they run the synthetic counter policy — the load-bench and
-    chaos-test fleet."""
+    chaos-test fleet.
+
+    ``telemetry_dir`` is the per-process stream root: each replica writes
+    its own ``replicas/replica_NNN/telemetry.jsonl`` under it (trace spans,
+    clock handshake, profiler markers) and ``diag/trace.py`` merges them
+    with the gateway's stream."""
     sel = cfg.select if hasattr(cfg, "select") else (lambda p, d=None: d)
 
     spec_base: dict = {
+        "telemetry_dir": str(telemetry_dir) if telemetry_dir else None,
         "buckets": list(sel("gateway.replica.buckets", [1, 2, 4, 8, 16]) or [1, 2, 4, 8, 16]),
         "max_wait_ms": float(sel("gateway.replica.max_wait_ms", 5.0)),
         "max_pending": int(sel("gateway.replica.max_pending", 256)),
@@ -89,6 +96,7 @@ def build_cluster(
         max_pins=int(sel("gateway.router.max_pins", 1_000_000)),
         sink=sink,
         log_every_s=float(sel("gateway.telemetry.log_every_s", 10.0)),
+        trace_sample=float(sel("gateway.telemetry.trace_sample", 0.0) or 0.0),
     )
     if start:
         manager.start()
@@ -106,10 +114,14 @@ def gateway_from_checkpoint(ckpt_path: Any, cfg: Any, block: bool = True) -> Gat
     ckpt_path = pathlib.Path(ckpt_path)
     sel = cfg.select
     sink = None
+    telemetry_dir = None
     if bool(sel("gateway.telemetry.jsonl", True)):
         run_dir = ckpt_path.parent.parent
         sink = JsonlSink(str(run_dir / "gateway" / "telemetry.jsonl"))
-    gateway = build_cluster(cfg, ckpt_path=ckpt_path, sink=sink, start=True)
+        telemetry_dir = run_dir  # replicas write replicas/replica_NNN/ here
+    gateway = build_cluster(
+        cfg, ckpt_path=ckpt_path, sink=sink, start=True, telemetry_dir=telemetry_dir
+    )
     print(
         f"[gateway] {gateway.manager.num_replicas} replica(s) behind "
         f"http://{gateway.host}:{gateway.port}",

@@ -44,11 +44,13 @@ difference: ``POST /v1/act``, ``GET /healthz`` (fleet view), ``GET /stats``
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..serve.batcher import jittered_retry_after
+from ..telemetry import tracing
 from .admission import AdmissionController, Shed
 from .broker import SessionBroker
 from .replica import ReplicaHandle, ReplicaManager
@@ -273,6 +275,7 @@ class Gateway:
         max_pins: int = 1_000_000,
         sink: Any = None,
         log_every_s: float = 10.0,
+        trace_sample: float = 0.0,
     ) -> None:
         self.manager = manager
         self.broker = broker if broker is not None else SessionBroker()
@@ -286,6 +289,10 @@ class Gateway:
         self.shed_deterministic = bool(shed_deterministic)
         self._sink = sink
         self._log_every_s = float(log_every_s)
+        # a request is traced when the client sent a traceparent; on top of
+        # that, trace_sample self-originates a trace for that fraction of
+        # un-instrumented traffic (0 = only client-initiated traces)
+        self.trace_sample = max(0.0, min(1.0, float(trace_sample)))
         self._last_log = time.monotonic()
         self._conn_local = threading.local()  # per-thread replica keep-alives
         self._httpd: Any = None
@@ -315,6 +322,12 @@ class Gateway:
             pool = self._conn_local.conns = {}
         payload = json.dumps(body).encode()
         headers = {"Content-Type": "application/json"}
+        # the gateway→replica hop carries the W3C header too (body field
+        # covers stubbed transports; the header is what standard tooling
+        # and the replica's HTTP layer look for). Derived from the body so
+        # the test-stubbed `_post(url, body, timeout)` signature holds.
+        if body.get("traceparent"):
+            headers["traceparent"] = str(body["traceparent"])
         last_err: Optional[BaseException] = None
         for fresh in (False, True):
             conn = None if fresh else pool.pop(key, None)
@@ -363,10 +376,28 @@ class Gateway:
     def handle_act(self, payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
         """Admit, route, forward, absorb the latent, ack. Returns
         ``(status, body, headers)`` ready for the HTTP layer (or in-process
-        callers: the bench and the tests drive this directly too)."""
+        callers: the bench and the tests drive this directly too).
+
+        A ``traceparent`` in the payload (the HTTP layer copies the header
+        in) makes the request traced: the gateway stamps its own stage
+        spans (admission → route → forward → broker put), forwards the
+        context to the replica, and returns the merged per-stage timing in
+        the response body."""
         t0 = time.monotonic()
         self.stats.record_request()
+        parent = tracing.parse_traceparent(payload.get("traceparent"))
+        if parent is None and self.trace_sample > 0 and random.random() < self.trace_sample:
+            parent = (tracing.new_trace_id(), tracing.new_span_id())
+        trace: Optional[Dict[str, Any]] = None
+        if parent is not None:
+            trace = {
+                "ctx": tracing.child_context(parent),
+                "t0": t0,
+                "t0_wall": time.time(),
+                "stages": {},
+            }
         priority = self.classify_priority(payload)
+        t_adm0 = time.monotonic()
         try:
             self.admission.admit(priority)
         except Shed as e:
@@ -377,20 +408,23 @@ class Gateway:
                 {"error": str(e), "reason": e.reason, "retry_after_s": round(e.retry_after_s, 3)},
                 {"Retry-After": f"{max(1, int(round(e.retry_after_s)))}"},
             )
+        if trace is not None:
+            trace["stages"]["admission"] = (t_adm0, time.monotonic())
         try:
-            return self._forward_with_failover(payload, t0)
+            return self._forward_with_failover(payload, t0, trace)
         finally:
             self.admission.release()
             self._maybe_emit()
 
     def _forward_with_failover(
-        self, payload: Dict[str, Any], t0: float
+        self, payload: Dict[str, Any], t0: float, trace: Optional[Dict[str, Any]] = None
     ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
         sid = payload.get("session_id")
         sid = str(sid) if sid is not None else None
         force_state = False
         last_err: Optional[str] = None
         for attempt in range(self.max_attempts):
+            t_route0 = time.monotonic()
             try:
                 handle, needs_state, migrated = self.router.route(sid)
             except NoReplicasAvailable:
@@ -402,10 +436,18 @@ class Gateway:
                     {"error": "no replica available", "retry_after_s": round(retry, 3)},
                     {"Retry-After": f"{max(1, int(round(retry)))}"},
                 )
+            if trace is not None:
+                trace["stages"]["route"] = (t_route0, time.monotonic())
             body = {
                 "obs": payload.get("obs"),
                 "deterministic": bool(payload.get("deterministic", False)),
             }
+            if trace is not None:
+                # the replica hop continues THIS trace: its stage spans land
+                # on the replica's own stream with the same trace_id
+                body["traceparent"] = tracing.make_traceparent(
+                    trace["ctx"].trace_id, trace["ctx"].span_id
+                )
             if sid is not None:
                 body["session_id"] = sid
                 body["return_state"] = True
@@ -429,6 +471,7 @@ class Gateway:
                             {"error": "session_lost", "session_id": sid},
                             {},
                         )
+            t_fwd0 = time.monotonic()
             try:
                 status, resp, headers = self._post(
                     f"{handle.url}/v1/act", body, self.forward_timeout_s
@@ -450,16 +493,23 @@ class Gateway:
                 last_err = "session_expired"
                 continue
             if status == 200:
+                if trace is not None:
+                    trace["stages"]["forward"] = (t_fwd0, time.monotonic())
                 blob = resp.pop("session_state", None)
                 if sid is not None:
                     if blob is not None:
+                        t_put0 = time.monotonic()
                         resp["session_version"] = self.broker.put(sid, blob)
+                        if trace is not None:
+                            trace["stages"]["broker_put"] = (t_put0, time.monotonic())
                     # the ack — not the routing decision — is what proves the
                     # replica's cache holds the session now
                     self.router.confirm(sid, handle, stateful=blob is not None)
                     if migrated:
                         self.stats.record_migration()
                 resp["replica"] = handle.replica_id
+                if trace is not None:
+                    self._finish_trace(trace, resp, handle.replica_id, sid)
                 self.stats.record_outcome(time.monotonic() - t0, acked=True)
                 return 200, resp, {}
             # non-retryable upstream answer (400 bad obs, 503 backpressure,
@@ -476,6 +526,51 @@ class Gateway:
             {"error": f"all {self.max_attempts} forward attempts failed", "last_error": last_err},
             {},
         )
+
+    def _finish_trace(
+        self,
+        trace: Dict[str, Any],
+        resp: Dict[str, Any],
+        replica_id: int,
+        sid: Optional[str],
+    ) -> None:
+        """Close out a traced ack: merge the replica's timing under the
+        gateway's stage breakdown in the response body, and emit one
+        ``trace_span`` per gateway stage (sink + Prometheus mirror)."""
+        ctx = trace["ctx"]
+        anchor = trace["t0_wall"] - trace["t0"]  # wall == mono + anchor
+        timing: Dict[str, Any] = {}
+        replica_timing = resp.pop("timing", None)
+        for name, (a, b) in trace["stages"].items():
+            timing[f"{name}_ms"] = round((b - a) * 1000.0, 4)
+            rec = tracing.span_record(
+                name,
+                "gateway",
+                tracing.TraceContext(ctx.trace_id, tracing.new_span_id(), ctx.span_id),
+                a + anchor,
+                b + anchor,
+                replica=int(replica_id),
+            )
+            if sid is not None:
+                rec["session_id"] = sid
+            self._trace_emit(rec)
+        if replica_timing:
+            timing["replica"] = replica_timing
+        resp["timing"] = timing
+        resp["trace_id"] = ctx.trace_id
+
+    def _trace_emit(self, rec: Dict[str, Any]) -> None:
+        # the span goes to both surfaces: the JSONL stream diag/trace.py
+        # merges, and the live registry's role/stage-labeled histograms
+        if self._sink is not None:
+            try:
+                self._sink.write(rec)
+            except Exception:
+                pass
+        try:
+            self.stats.registry.observe_event(rec)
+        except Exception:
+            pass
 
     # -- fleet views ---------------------------------------------------------
     def health(self) -> Dict[str, Any]:
@@ -620,6 +715,28 @@ def _make_handler(gw: "Gateway"):
             if self.path == "/admin/rolling_reload":
                 self._reply(200, {"results": gw.manager.rolling_reload()})
                 return
+            if self.path == "/admin/profile":
+                # on-demand remote profiling fan-out: open a windowed
+                # jax.profiler capture on one replica (default: the first
+                # routable). {"replica": id?, "duration_s": 2.0?}
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    payload = payload if isinstance(payload, dict) else {}
+                except (ValueError, json.JSONDecodeError):
+                    payload = {}
+                try:
+                    rid = payload.get("replica")
+                    rid = int(rid) if rid is not None else None
+                    duration_s = float(payload.get("duration_s") or 2.0)
+                    if rid is not None and not 0 <= rid < gw.manager.num_replicas:
+                        raise ValueError(f"replica {rid} out of range")
+                except (TypeError, ValueError) as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                out = gw.manager.request_profile(rid, duration_s)
+                self._reply(200 if "error" not in out else 503, out)
+                return
             if self.path not in ("/v1/act", "/act"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
@@ -631,6 +748,12 @@ def _make_handler(gw: "Gateway"):
             except (ValueError, json.JSONDecodeError) as e:
                 self._reply(400, {"error": str(e)})
                 return
+            # the client→gateway hop's W3C header: copied into the payload
+            # so the in-process act path (and the bench driving it
+            # directly) sees one trace-context field either way
+            header_tp = self.headers.get("traceparent")
+            if header_tp and not payload.get("traceparent"):
+                payload["traceparent"] = header_tp
             try:
                 status, body, headers = gw.handle_act(payload)
             except Exception as e:  # the routing plane must never 500 raw

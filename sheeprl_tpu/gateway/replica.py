@@ -41,6 +41,7 @@ import random
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 from collections import deque
 from typing import Any, Dict, List, Optional
@@ -84,6 +85,20 @@ def _build_replica_server(spec: Dict[str, Any]) -> Any:
     from ..serve.server import PolicyServer
 
     mode = str(spec.get("mode", "synthetic"))
+    # the replica's OWN telemetry stream (replicas/replica_NNN/ under the
+    # run dir): serve snapshots, trace spans of traced requests, the clock
+    # handshake answers and profiler markers all land here, and
+    # diag/trace.py merges it with the gateway's stream on trace_id
+    sink = None
+    if spec.get("telemetry_dir"):
+        from ..telemetry.tracing import open_process_stream
+
+        sink = open_process_stream(
+            spec["telemetry_dir"],
+            "replica",
+            int(spec.get("replica_id", 0)),
+            incarnation=int(spec.get("incarnation", 0)),
+        )
     reloader = None
     if mode == "checkpoint":
         import pathlib
@@ -126,6 +141,7 @@ def _build_replica_server(spec: Dict[str, Any]) -> Any:
         max_wait_ms=float(spec.get("max_wait_ms", 5.0)),
         max_pending=int(spec.get("max_pending", 256)),
         request_timeout_s=float(spec.get("request_timeout_s", 30.0)),
+        sink=sink,
     )
 
     on_act = None
@@ -157,6 +173,8 @@ def _build_replica_server(spec: Dict[str, Any]) -> Any:
         host=str(spec.get("host", "127.0.0.1")),
         port=0,  # ephemeral: the bound port is reported through the queue
         on_act=on_act,
+        sink=sink,
+        replica_id=int(spec.get("replica_id", 0)),
     )
 
 
@@ -356,11 +374,64 @@ class ReplicaManager:
                 body = json.loads(resp.read())
         except Exception:
             return False
+        first_healthy = handle.last_healthy <= 0.0
         handle.last_healthy = time.monotonic()
         handle.suspect = False
         handle.params_version = int(body.get("params_version", -1))
         handle.reload_staleness_s = float(body.get("reload_staleness_s", float("inf")))
+        if first_healthy:
+            # clock-offset handshake, once per incarnation as it comes up:
+            # the replica answers by emitting a `clock` event on its OWN
+            # stream, which diag/trace.py uses to align the streams
+            self._clock_probe(handle)
         return True
+
+    def _clock_probe(self, handle: ReplicaHandle) -> None:
+        try:
+            req = urllib.request.Request(
+                f"{handle.url}/admin/clock",
+                data=json.dumps({"t_send": time.time()}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=self.health_timeout_s):
+                pass
+        except Exception:
+            pass  # best-effort: an unsynced stream merges with offset 0
+
+    def request_profile(
+        self, replica_id: Optional[int] = None, duration_s: float = 2.0
+    ) -> Dict[str, Any]:
+        """Trigger a windowed ``jax.profiler`` capture on one replica
+        (default: the first routable one) via ``POST /admin/profile`` —
+        the serving half of the on-demand remote-profiling control plane."""
+        if replica_id is None:
+            routable = self.routable()
+            if not routable:
+                return {"error": "no routable replica"}
+            handle = routable[0]
+        else:
+            handle = self.handles[int(replica_id)]
+        if handle.url is None:
+            return {"error": f"replica {handle.replica_id} has no bound port"}
+        try:
+            req = urllib.request.Request(
+                f"{handle.url}/admin/profile",
+                data=json.dumps({"duration_s": float(duration_s)}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            # generous deadline: the first jax.profiler.start_trace in a
+            # process initializes the profiler backend (~10s observed on
+            # CPU) — a control-plane op, not a latency-critical one
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                body = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return {"replica": handle.replica_id, "error": f"HTTP {e.code}"}
+        except Exception as e:
+            return {"replica": handle.replica_id, "error": repr(e)}
+        body["replica"] = handle.replica_id
+        return body
 
     def monitor_once(self) -> None:
         """One supervision sweep: collect ports, detect crashes/hangs, run

@@ -53,7 +53,17 @@ class Backpressure(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("obs", "deterministic", "session", "event", "result", "error", "t_submit")
+    __slots__ = (
+        "obs",
+        "deterministic",
+        "session",
+        "event",
+        "result",
+        "error",
+        "t_submit",
+        "t_batch_start",
+        "t_batch_end",
+    )
 
     def __init__(self, obs: Any, deterministic: bool, session: Optional[str]) -> None:
         self.obs = obs
@@ -63,6 +73,12 @@ class _Request:
         self.result: Any = None
         self.error: Optional[BaseException] = None
         self.t_submit = time.monotonic()
+        # stage boundaries for the per-request latency breakdown (tracing):
+        # submit → batch_start is batcher-queue wait, batch_start →
+        # batch_end is the coalesced jit step, batch_end → completion is
+        # the scatter/export back to this caller
+        self.t_batch_start = 0.0
+        self.t_batch_end = 0.0
 
 
 class ServeStats:
@@ -243,6 +259,7 @@ class MicroBatcher:
         deterministic: bool = False,
         session: Optional[str] = None,
         timeout_s: Optional[float] = None,
+        timing_out: Optional[Dict[str, Any]] = None,
     ) -> Any:
         """Enqueue one observation; block until its action row is ready.
 
@@ -250,6 +267,12 @@ class MicroBatcher:
         :class:`SessionExpired` when the session's state was LRU-evicted
         (the caller must re-hydrate or restart the session) and
         ``TimeoutError`` when the request is not served within the timeout.
+
+        With ``timing_out`` (a dict the caller owns), the per-stage latency
+        breakdown is filled in on success: ``batch_queue_ms`` /
+        ``jit_step_ms`` / ``export_ms`` plus the raw monotonic boundaries
+        under ``"mono"`` (the HTTP layer converts those into wall-clock
+        trace spans).
         """
         self.start()
         # expired sessions fail BEFORE batching: silently re-initializing an
@@ -286,6 +309,12 @@ class MicroBatcher:
             raise TimeoutError(f"policy request not served within {timeout}s")
         if req.error is not None:
             raise req.error
+        if timing_out is not None and req.t_batch_start > 0.0:
+            done = time.monotonic()
+            timing_out["batch_queue_ms"] = round((req.t_batch_start - req.t_submit) * 1000.0, 4)
+            timing_out["jit_step_ms"] = round((req.t_batch_end - req.t_batch_start) * 1000.0, 4)
+            timing_out["export_ms"] = round((done - req.t_batch_end) * 1000.0, 4)
+            timing_out["mono"] = (req.t_submit, req.t_batch_start, req.t_batch_end, done)
         return req.result
 
     def _retry_after_locked(self) -> float:
@@ -334,6 +363,8 @@ class MicroBatcher:
         n = len(batch)
         t0 = time.monotonic()
         expired: List[int] = []
+        for req in batch:
+            req.t_batch_start = t0
         try:
             obs = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *[r.obs for r in batch])
             actions = self.policy.act_batch(
@@ -350,7 +381,10 @@ class MicroBatcher:
                 self.stats.record_done(now - req.t_submit, error=True)
                 req.event.set()
             return
-        dt = time.monotonic() - t0
+        t_exec_end = time.monotonic()
+        dt = t_exec_end - t0
+        for req in batch:
+            req.t_batch_end = t_exec_end
         from .policy import _bucket_for
 
         self.stats.record_batch(n, _bucket_for(n, self.policy.buckets), dt)

@@ -14,9 +14,11 @@ two ways:
 Endpoints:
 
     POST /v1/act      {"obs": {...}, "deterministic": bool, "session_id": str,
-                       "session_state": b64?, "return_state": bool?}
+                       "session_state": b64?, "return_state": bool?,
+                       "traceparent": str?}
                       -> {"actions": [[...]], "params_version": int,
-                          "session_state": b64?}
+                          "session_state": b64?, "trace_id": str?,
+                          "timing": {batch_queue_ms, jit_step_ms, export_ms}?}
     GET  /healthz     liveness + params version + reload staleness seconds
     GET  /stats       full serve telemetry snapshot (the `serve` JSONL record,
                       incl. p50/p95/p99 latency)
@@ -24,9 +26,22 @@ Endpoints:
                       histograms backed by diag/prometheus.py's registry)
     POST /admin/reload  force one checkpoint-reload poll (the gateway's
                       rolling-drain hook)
+    POST /admin/clock   clock-offset handshake ({"t_send": wall}): answers
+                      {"t_recv", "offset_s"} and emits a `clock` event on
+                      the replica's stream — what lets diag/trace.py align
+                      this process's spans with the gateway's
+    POST /admin/profile on-demand windowed jax.profiler capture
+                      ({"duration_s": 2.0}): 200 {started, trace_dir} or
+                      409 while a window is already open
     410 session_expired when a live session's latent was LRU-evicted (the
                       gateway re-hydrates it from the broker and retries)
     503 + Retry-After (jittered) when the queue is saturated (Backpressure)
+
+A request that carries a ``traceparent`` (W3C header, or the same string as
+a JSON field) gets the per-stage latency breakdown in its response AND has
+its stages written as ``trace_span`` events to the replica's own telemetry
+stream — the replica half of the cross-process critical path
+(`sheeprl_tpu trace` joins it with the gateway's spans on trace_id).
 
 `serve_from_checkpoint` is the CLI entrypoint's workhorse: checkpoint →
 policy (+warmup) → batcher → reloader → HTTP, with serve telemetry JSONL
@@ -35,8 +50,10 @@ written next to the run (``<run_dir>/serve/telemetry.jsonl``).
 from __future__ import annotations
 
 import json
+import os
 import pathlib
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -63,6 +80,8 @@ class PolicyServer:
         port: int = 0,
         http_enabled: bool = True,
         on_act: Optional[Any] = None,
+        sink: Any = None,
+        replica_id: int = 0,
     ) -> None:
         self.policy = policy
         self.batcher = batcher
@@ -71,6 +90,22 @@ class PolicyServer:
         self._requested_port = int(port)
         self.http_enabled = bool(http_enabled)
         self.on_act = on_act
+        # the replica's own telemetry stream (trace spans, clock handshake
+        # answers, profiler markers); None = tracing surfaces disabled
+        self.sink = sink
+        self.replica_id = int(replica_id)
+        from ..telemetry.tracing import RemoteProfiler
+
+        profile_root = (
+            os.path.join(os.path.dirname(getattr(sink, "path", "")), "xprof")
+            if sink is not None and getattr(sink, "path", None)
+            else os.path.join("logs", "xprof_serve")
+        )
+        self.profiler = RemoteProfiler(
+            profile_root,
+            emit=(sink.write if sink is not None else None),
+            role="replica",
+        )
         self._httpd: Any = None
         self._http_thread: Optional[threading.Thread] = None
 
@@ -81,12 +116,57 @@ class PolicyServer:
         deterministic: bool = False,
         session: Optional[str] = None,
         timeout_s: Optional[float] = None,
+        timing_out: Optional[Dict[str, Any]] = None,
     ) -> np.ndarray:
         """Blocking single-observation request through the micro-batcher."""
-        return self.batcher.submit(obs, deterministic=deterministic, session=session, timeout_s=timeout_s)
+        return self.batcher.submit(
+            obs,
+            deterministic=deterministic,
+            session=session,
+            timeout_s=timeout_s,
+            timing_out=timing_out,
+        )
 
     def stats(self) -> Dict[str, Any]:
         return self.batcher.serve_record()
+
+    def _emit_act_spans(self, ctx: Any, timing: Dict[str, Any], session: Optional[str]) -> None:
+        """Write the request's stage spans (batch_queue → jit_step →
+        export) to the replica's own stream. The batcher reports monotonic
+        stage boundaries; they are re-anchored onto the wall clock here so
+        the merger can align them with the gateway's spans."""
+        if self.sink is None:
+            return
+        mono = timing.get("mono")
+        if not mono:
+            return
+        from ..telemetry import tracing
+
+        t_wall_end = time.time()
+        anchor = t_wall_end - mono[3]  # wall == mono + anchor, per-request
+        bounds = [m + anchor for m in mono]
+        try:
+            for name, (a, b) in (
+                ("batch_queue", (bounds[0], bounds[1])),
+                ("jit_step", (bounds[1], bounds[2])),
+                ("export", (bounds[2], bounds[3])),
+            ):
+                rec = tracing.span_record(
+                    name,
+                    "replica",
+                    tracing.TraceContext(ctx.trace_id, tracing.new_span_id(), ctx.span_id),
+                    a,
+                    b,
+                    replica=self.replica_id,
+                )
+                if session is not None:
+                    rec["session_id"] = str(session)
+                self.sink.write(rec)
+                # the live mirror: stage_latency_ms{role="replica",stage=...}
+                # on this replica's own GET /metrics
+                self.batcher.stats.registry.observe_event(rec)
+        except Exception:
+            pass
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of the serving registry (latency /
@@ -143,6 +223,7 @@ class PolicyServer:
             self._http_thread = None
         if self.reloader is not None:
             self.reloader.stop()
+        self.profiler.stop()  # close a live on-demand capture window
         self.batcher.stop()
 
 
@@ -197,6 +278,12 @@ def _make_handler(server: "PolicyServer"):
             if self.path in ("/admin/reload",):
                 self._admin_reload()
                 return
+            if self.path in ("/admin/clock",):
+                self._admin_clock()
+                return
+            if self.path in ("/admin/profile",):
+                self._admin_profile()
+                return
             if self.path not in ("/v1/act", "/act"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
@@ -226,10 +313,22 @@ def _make_handler(server: "PolicyServer"):
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 self._reply(400, {"error": str(e)})
                 return
+            # trace context: the W3C header wins, the JSON field covers
+            # clients that cannot set headers; either makes this request
+            # traced (timing in the body + spans on the replica stream)
+            from ..telemetry import tracing
+
+            parent = tracing.parse_traceparent(
+                self.headers.get("traceparent") or payload.get("traceparent")
+            )
+            ctx = tracing.child_context(parent) if parent is not None else None
+            timing: Optional[Dict[str, Any]] = {} if ctx is not None else None
             if server.on_act is not None:
                 server.on_act()
             try:
-                actions = server.act(obs, deterministic=deterministic, session=session)
+                actions = server.act(
+                    obs, deterministic=deterministic, session=session, timing_out=timing
+                )
             except SessionExpired as e:
                 # the latent was LRU-evicted: tell the caller (the gateway
                 # translates this into a broker re-hydrate + retry) instead
@@ -258,6 +357,11 @@ def _make_handler(server: "PolicyServer"):
                 "actions": np.asarray(actions).tolist(),
                 "params_version": server.policy.params_version,
             }
+            if ctx is not None and timing:
+                body["trace_id"] = ctx.trace_id
+                server._emit_act_spans(ctx, timing, session)
+                timing.pop("mono", None)
+                body["timing"] = timing
             if return_state and session is not None:
                 row = server.policy.export_session(session)
                 if row is not None:
@@ -290,6 +394,54 @@ def _make_handler(server: "PolicyServer"):
             self._reply(
                 200, {"swapped": swapped, "params_version": server.policy.params_version}
             )
+
+        def _read_json(self) -> Dict[str, Any]:
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                return payload if isinstance(payload, dict) else {}
+            except (ValueError, json.JSONDecodeError):
+                return {}
+
+        def _admin_clock(self) -> None:
+            """Clock-offset handshake: the caller's wall-clock send stamp in,
+            this process's receive stamp (and the offset upper bound) out —
+            also emitted as a `clock` event on the replica's stream for the
+            trace merger."""
+            from ..telemetry import tracing
+
+            payload = self._read_json()
+            t_send = payload.get("t_send")
+            if not isinstance(t_send, (int, float)):
+                self._reply(400, {"error": "body must carry a numeric 't_send'"})
+                return
+            rec = tracing.clock_record(float(t_send), role="replica", replica=server.replica_id)
+            if server.sink is not None:
+                try:
+                    server.sink.write(rec)
+                except Exception:
+                    pass
+            self._reply(200, {"t_recv": rec["t_recv"], "offset_s": rec["offset_s"]})
+
+        def _admin_profile(self) -> None:
+            """On-demand windowed jax.profiler capture (the serving half of
+            the remote-profiling control plane; the fleet half is the
+            CTRL_PROFILE ctrl-queue op). One window at a time — 409 while
+            a capture is already open."""
+            payload = self._read_json()
+            try:
+                duration_s = float(payload.get("duration_s") or 2.0)
+            except (TypeError, ValueError) as e:
+                self._reply(400, {"error": f"bad duration_s: {e}"})
+                return
+            trace_dir = server.profiler.start(duration_s, use_timer=True)
+            if trace_dir is None:
+                self._reply(
+                    409,
+                    {"error": "profiler window already open (or backend cannot profile)"},
+                )
+                return
+            self._reply(200, {"started": True, "trace_dir": trace_dir, "duration_s": duration_s})
 
     return Handler
 
@@ -339,6 +491,7 @@ def serve_from_checkpoint(ckpt_path: Any, cfg: Any, block: bool = True) -> Polic
         host=str(sel("serve.http.host", "127.0.0.1")),
         port=int(sel("serve.http.port", 8190)),
         http_enabled=bool(sel("serve.http.enabled", True)),
+        sink=sink,  # traced requests write their stage spans here too
     )
     if sink is not None:
         sink.write(batcher.serve_record())  # startup snapshot (warmup state)

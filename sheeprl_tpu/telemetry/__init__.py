@@ -9,6 +9,18 @@ from .facade import Telemetry
 from .schema import EVENT_SCHEMAS, SCHEMA_VERSION, validate_event, validate_jsonl
 from .sinks import ConsoleHeartbeat, JsonlSink, write_event
 from .spans import GLOBAL_TRACKER, Span, SpanTracker
+from .tracing import (
+    RemoteProfiler,
+    TraceContext,
+    child_context,
+    clock_record,
+    make_traceparent,
+    new_span_id,
+    new_trace_id,
+    open_process_stream,
+    parse_traceparent,
+    span_record,
+)
 from .throughput import (
     PEAK_FLOPS,
     ThroughputTracker,
@@ -41,6 +53,16 @@ __all__ = [
     "GLOBAL_TRACKER",
     "Span",
     "SpanTracker",
+    "RemoteProfiler",
+    "TraceContext",
+    "child_context",
+    "clock_record",
+    "make_traceparent",
+    "new_span_id",
+    "new_trace_id",
+    "open_process_stream",
+    "parse_traceparent",
+    "span_record",
     "PEAK_FLOPS",
     "ThroughputTracker",
     "flops_of_lowered",

@@ -24,7 +24,10 @@ _DICT = dict
 # event type → {field: (required, type)}
 EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
     # emitted once at Telemetry.setup: the record that makes cpu-fallback
-    # impossible to miss
+    # impossible to miss. Per-process streams (fleet workers, gateway
+    # replicas — telemetry/tracing.py open_process_stream) reuse it as
+    # their heartbeat with role/pid/incarnation stamped, so a merged run
+    # can attribute every stream to a process identity.
     "startup": {
         "platform": (True, _STR),
         "device_kind": (True, _STR),
@@ -34,6 +37,11 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "algo": (False, _STR),
         "run_name": (False, _STR),
         "schema_version": (False, _NUM),
+        "role": (False, _STR),  # worker | replica | learner | gateway
+        "pid": (False, _NUM),
+        "incarnation": (False, _NUM),
+        "worker": (False, _NUM),
+        "replica": (False, _NUM),
     },
     # one per log interval
     "log": {
@@ -74,11 +82,53 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
     "bench_progress": {
         "msg": (True, _STR),
     },
-    # windowed profiler capture markers
+    # windowed profiler capture markers — both the in-loop cadence captures
+    # (metric.telemetry.trace_every) and the on-demand remote captures
+    # (RemoteProfiler: replica POST /admin/profile, fleet CTRL_PROFILE)
     "trace": {
         "step": (True, _NUM),
         "action": (True, _STR),  # started | stopped
         "trace_dir": (False, _STR),
+        "role": (False, _STR),
+        "worker": (False, _NUM),
+        "replica": (False, _NUM),
+    },
+    # one distributed-tracing span (telemetry/tracing.py span_record): a
+    # named stage of a request or training-round critical path, stamped
+    # with W3C-width trace/span ids and wall-clock bounds. Per-process
+    # streams each carry their own side's spans; diag/trace.py joins them
+    # on trace_id into cross-process paths. `name` and `role` are LABELS
+    # (Prometheus stage_latency_ms + report rows) — literal at every emit
+    # site, enforced by the telemetry-schema-drift lint rule.
+    "trace_span": {
+        "name": (True, _STR),
+        "role": (True, _STR),  # worker | learner | player | gateway | replica
+        "trace_id": (True, _STR),
+        "span_id": (True, _STR),
+        "t_start": (True, _NUM),
+        "t_end": (True, _NUM),
+        "dur_ms": (True, _NUM),
+        "parent_id": (False, _STR),
+        "step": (False, _NUM),
+        "seq": (False, _NUM),
+        "version": (False, _NUM),
+        "worker": (False, _NUM),
+        "replica": (False, _NUM),
+        "session_id": (False, _STR),
+        "detail": (False, _STR),
+    },
+    # clock-offset handshake (telemetry/tracing.py clock_record): the
+    # coordinator's probe send time vs this process's receive time.
+    # offset_s upper-bounds the inter-process clock skew; the trace merger
+    # subtracts it (when above its skew_min_s floor) before aligning
+    # streams on one time axis.
+    "clock": {
+        "role": (True, _STR),
+        "t_send": (True, _NUM),
+        "t_recv": (True, _NUM),
+        "offset_s": (True, _NUM),
+        "worker": (False, _NUM),
+        "replica": (False, _NUM),
     },
     # policy-serving stat snapshot (serve/batcher.py): queue depth, batch
     # occupancy, latency percentiles, retrace/reload counters
@@ -295,6 +345,14 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "duration_s": (False, _NUM),
         "failover": (False, _DICT),  # {killed_replica, recovery_s, acked_loss}
         "platform": (False, _STR),
+        # per-stage latency breakdown from the trace-context timing the
+        # driver requests (traceparent on every bench request): full
+        # percentiles per stage in `stages`, plus flattened p95s for the
+        # stages bench_compare.py gates with the lower-is-better direction
+        "stages": (False, _DICT),  # {stage: {p50_ms, p95_ms, p99_ms}}
+        "stage_forward_p95_ms": (False, _NUM),
+        "stage_jit_step_p95_ms": (False, _NUM),
+        "stage_batch_queue_p95_ms": (False, _NUM),
     },
 }
 

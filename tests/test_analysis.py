@@ -423,6 +423,54 @@ def test_schema_green(tmp_path):
     assert findings == []
 
 
+def test_schema_red_dynamic_span_and_event_names(tmp_path):
+    # the label-cardinality guard: dynamically formatted span/event names
+    # become unbounded Prometheus label sets / schema keys
+    rule = TelemetrySchemaRule(schema=FAKE_SCHEMA)
+    findings, f = _lint(
+        tmp_path,
+        """
+        def report(telem, i, step, kind):
+            telem.emit({"event": f"demo_{i}", "step": step})
+            telem.emit({"event": "fault_" + kind, "step": step})
+            telem.emit({"event": "demo_{}".format(i), "step": step})
+            with telem.span(f"Time/worker_{i}"):
+                pass
+            with telem.span("Time/stage_%d" % i):
+                pass
+        """,
+        rule,
+    )
+    assert [x.line for x in findings] == [3, 4, 5, 6, 8]
+    assert all(x.rule_id == "telemetry-schema-drift" for x in findings)
+    assert all("label-cardinality" in x.message for x in findings)
+    assert "non-literal event name" in findings[0].message
+    assert "non-literal span name" in findings[3].message
+
+
+def test_schema_green_literal_and_passthrough_names(tmp_path):
+    # literals are fine, and a bare variable passthrough is allowed (the
+    # literal lives at the binding site — flagging every Name is noise)
+    rule = TelemetrySchemaRule(schema=FAKE_SCHEMA)
+    findings, _ = _lint(
+        tmp_path,
+        """
+        SPAN_NAME = "Time/train_time"
+
+        def report(telem, step, name):
+            telem.emit({"event": "demo", "step": step})
+            with telem.span("Time/train_time"):
+                pass
+            with telem.span(SPAN_NAME):
+                pass
+            with telem.span(name):
+                pass
+        """,
+        rule,
+    )
+    assert findings == []
+
+
 def test_schema_real_repo_emit_sites_validate():
     # the actual telemetry facade + subsystems against the actual schema
     findings = run_paths([REPO / "sheeprl_tpu" / "telemetry"], [TelemetrySchemaRule()])

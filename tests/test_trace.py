@@ -1,0 +1,446 @@
+"""Distributed tracing (telemetry/tracing.py + diag/trace.py).
+
+The invariants:
+
+* traceparent generation/parsing round-trips and rejects malformed headers
+  (a hostile client must start a fresh trace, never crash the act path);
+* per-process streams merge with clock-skew correction — offsets below the
+  floor are delivery latency and must NOT shift a stream, offsets above it
+  are genuine skew and must; rotated segments round-trip through the merge;
+* trace reconstruction joins worker/learner (and gateway/replica) spans on
+  trace_id into complete cross-process critical paths with a per-stage
+  latency table;
+* the `cross_process_stall` doctor finding fires on wait-dominated paths
+  and stays quiet on healthy ones;
+* the MicroBatcher reports per-request stage boundaries, and the gateway
+  propagates a traceparent to the replica hop and returns merged per-stage
+  timing on the ack;
+* labeled Prometheus histograms (stage_latency_ms{role=...,stage=...})
+  render one TYPE block per family with per-child label sets;
+* LIVE fleet smoke: a real 2-worker SAC run writes schema-valid per-worker
+  streams (role/pid/incarnation heartbeat, clock handshake), every
+  learner-applied packet's trace_id appears in exactly ONE worker stream,
+  and `sheeprl_tpu trace` reconstructs >= 95% of applied packets into
+  complete cross-process paths.
+"""
+import json
+from pathlib import Path
+
+import pytest
+
+from sheeprl_tpu.diag.findings import detect_cross_process_stall, run_detectors
+from sheeprl_tpu.diag.timeline import Timeline
+from sheeprl_tpu.diag.trace import (
+    analyze,
+    build_traces,
+    discover_streams,
+    merge_streams,
+    render_text,
+    stream_clock_offset,
+)
+from sheeprl_tpu.telemetry import tracing
+from sheeprl_tpu.telemetry.schema import validate_event, validate_jsonl
+
+
+# ---------------------------------------------------------------------------
+# unit: trace context + traceparent
+# ---------------------------------------------------------------------------
+def test_traceparent_roundtrip_and_rejection():
+    tid, sid = tracing.new_trace_id(), tracing.new_span_id()
+    assert len(tid) == 32 and len(sid) == 16
+    header = tracing.make_traceparent(tid, sid)
+    assert tracing.parse_traceparent(header) == (tid, sid)
+    # malformed headers start a fresh trace (None), never raise
+    for bad in (None, "", "garbage", "00-xx-yy-01", "00-" + "0" * 32 + "-" + sid + "-01",
+                "00-" + tid[:-1] + "-" + sid + "-01", 42):
+        assert tracing.parse_traceparent(bad) is None
+    # child context inherits the trace, roots a new one without a parent
+    child = tracing.child_context((tid, sid))
+    assert child.trace_id == tid and child.parent_id == sid and child.span_id != sid
+    root = tracing.child_context(None)
+    assert root.trace_id != tid and root.parent_id == ""
+
+
+def test_span_and_clock_records_are_schema_valid():
+    ctx = tracing.child_context(None)
+    span = tracing.span_record("env_step", "worker", ctx, 100.0, 100.25, worker=3, seq=7)
+    assert validate_event(span) == []
+    assert span["dur_ms"] == 250.0
+    clock = tracing.clock_record(100.0, role="worker", worker=3)
+    assert validate_event(clock) == []
+    assert clock["offset_s"] == round(clock["t_recv"] - 100.0, 6)
+
+
+# ---------------------------------------------------------------------------
+# unit: remote profiler (control-plane plumbing; jax.profiler stubbed)
+# ---------------------------------------------------------------------------
+def test_remote_profiler_windows_and_single_capture(tmp_path, monkeypatch):
+    import jax.profiler as prof
+
+    calls = []
+    monkeypatch.setattr(prof, "start_trace", lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(prof, "stop_trace", lambda: calls.append(("stop",)))
+    events = []
+    p = tracing.RemoteProfiler(str(tmp_path / "xprof"), emit=events.append, role="replica")
+    d1 = p.start(duration_s=60.0)
+    assert d1 and p.active
+    assert p.start(duration_s=1.0) is None  # one window at a time
+    p.poll()  # deadline far away: still open
+    assert p.active
+    p.stop()
+    assert not p.active and calls == [("start", d1), ("stop",)]
+    assert [e["action"] for e in events] == ["started", "stopped"]
+    assert all(validate_event(e) == [] for e in events)
+    d2 = p.start(duration_s=0.0)  # clamped tiny window, closed by poll()
+    import time
+
+    time.sleep(0.08)
+    p.poll()
+    assert not p.active and d2 != d1
+
+
+# ---------------------------------------------------------------------------
+# unit: labeled Prometheus histograms
+# ---------------------------------------------------------------------------
+def test_prometheus_stage_histograms_labeled_by_role():
+    from sheeprl_tpu.diag.prometheus import Registry
+
+    reg = Registry()
+    ctx = tracing.child_context(None)
+    for role, stage, ms in (
+        ("worker", "env_step", 2.0),
+        ("worker", "queue_wait", 40.0),
+        ("learner", "learner_apply", 1.0),
+        ("worker", "env_step", 3.0),
+    ):
+        reg.observe_event(tracing.span_record(stage, role, ctx, 0.0, ms / 1000.0))
+    text = reg.render()
+    # one TYPE block per family, one labeled child per (role, stage)
+    assert text.count("# TYPE sheeprl_stage_latency_ms histogram") == 1
+    assert 'sheeprl_stage_latency_ms_count{role="worker",stage="env_step"} 2' in text
+    assert 'sheeprl_stage_latency_ms_count{role="worker",stage="queue_wait"} 1' in text
+    assert 'sheeprl_stage_latency_ms_count{role="learner",stage="learner_apply"} 1' in text
+    assert 'role="worker",stage="env_step",le="2.5"' in text
+    h = reg.histogram(
+        "stage_latency_ms", "", labels={"role": "worker", "stage": "env_step"}
+    )
+    assert h.count == 2  # get-or-create keys on the label set
+
+
+# ---------------------------------------------------------------------------
+# synthetic two-process merge: clock skew + rotation round-trip
+# ---------------------------------------------------------------------------
+def _write_jsonl(path: Path, events) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+
+
+def _synthetic_run(tmp_path: Path, skew_s: float = 30.0, rounds: int = 20) -> Path:
+    """A fleet-shaped run dir: the learner's stream plus one worker stream
+    whose clock runs ``skew_s`` ahead (every t shifted) with a matching
+    clock-handshake event — and the worker stream ROTATED into a .1
+    segment + live file."""
+    run = tmp_path / "version_0"
+    t0 = 1_000_000.0
+    main = [
+        {"event": "startup", "platform": "cpu", "device_kind": "cpu", "devices": 1, "rank": 0},
+    ]
+    worker = [
+        {"event": "startup", "platform": "cpu", "device_kind": "", "devices": 0,
+         "rank": 0, "role": "worker", "pid": 1234, "incarnation": 0},
+        {"event": "clock", "role": "worker", "t_send": t0, "t_recv": t0 + skew_s,
+         "offset_s": skew_s, "worker": 0},
+    ]
+    for i in range(rounds):
+        t = t0 + 1.0 + i * 0.1
+        ctx = tracing.TraceContext(tracing.new_trace_id(), tracing.new_span_id())
+        # worker-side spans live on the SKEWED clock
+        worker.append(
+            tracing.span_record("env_step", "worker", ctx, t + skew_s, t + 0.02 + skew_s,
+                                worker=0, seq=i)
+        )
+        worker.append(
+            tracing.span_record(
+                "queue_wait", "worker",
+                tracing.TraceContext(ctx.trace_id, tracing.new_span_id(), ctx.span_id),
+                t + 0.02 + skew_s, t + 0.025 + skew_s, worker=0, seq=i,
+            )
+        )
+        main.append(
+            tracing.span_record(
+                "learner_apply", "learner",
+                tracing.TraceContext(ctx.trace_id, tracing.new_span_id(), ctx.span_id),
+                t + 0.03, t + 0.032, worker=0, seq=i,
+            )
+        )
+    main.append({"event": "shutdown", "step": rounds})
+    _write_jsonl(run / "telemetry.jsonl", main)
+    wpath = run / "workers" / "worker_000" / "telemetry.jsonl"
+    # rotation round-trip: the first half rolled out as segment .1
+    half = len(worker) // 2
+    _write_jsonl(Path(str(wpath) + ".1"), worker[:half])
+    _write_jsonl(wpath, [{"event": "rotate", "segment": 1}] + worker[half:])
+    return run
+
+
+def test_merge_skew_corrects_and_reads_rotated_segments(tmp_path):
+    run = _synthetic_run(tmp_path, skew_s=30.0, rounds=20)
+    streams = dict((s["name"], s) for _, s in zip(range(99), merge_streams(run)[1]))
+    assert set(streams) == {"main", "worker_000"}
+    assert streams["worker_000"]["clock_offset_s"] == 30.0
+    # all rotated-segment events made it through the merge
+    assert streams["worker_000"]["events"] == 2 + 40 + 1  # heartbeat+clock+spans+rotate
+    report = analyze(run)
+    assert report["completeness"]["round"] == 1.0
+    assert report["anchored"]["round"] == 20
+    # skew-corrected: a round path spans ~32ms, not ~30s
+    assert all(v["duration_ms"] < 1000.0 for v in report["top"])
+    assert report["stages"]["worker/env_step"]["count"] == 20
+    text = render_text(report)
+    assert "round paths: 20/20 reconstructed cross-process (100.0%)" in text
+    assert "clock offset +30.000s" in text
+
+
+def test_merge_ignores_subskew_offsets(tmp_path):
+    # a same-host run: the handshake measures ~ms of delivery latency and
+    # the merger must NOT shift the stream by it
+    run = _synthetic_run(tmp_path, skew_s=0.0, rounds=4)
+    wstream = run / "workers" / "worker_000" / "telemetry.jsonl"
+    events = [json.loads(ln) for ln in open(str(wstream) + ".1")]
+    events[1]["offset_s"] = 0.002  # tiny, genuine-latency-shaped
+    _write_jsonl(Path(str(wstream) + ".1"), events)
+    assert stream_clock_offset(events) == 0.0
+    _, streams = merge_streams(run)
+    worker_meta = next(s for s in streams if s["name"] == "worker_000")
+    assert worker_meta["clock_offset_s"] == 0.0
+
+
+def test_trace_cli_json_and_trace_id_filter(tmp_path, capsys):
+    from sheeprl_tpu.diag.trace import main as trace_main
+
+    run = _synthetic_run(tmp_path, skew_s=30.0, rounds=5)
+    assert trace_main([f"run_dir={run}", "json=true"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["completeness"]["round"] == 1.0
+    tid = report["top"][0]["trace_id"]
+    assert trace_main([f"run_dir={run}", f"trace_id={tid[:8]}"]) == 0
+    out = capsys.readouterr().out
+    assert tid[:12] in out or tid in out
+    with pytest.raises(ValueError):
+        trace_main(["nonsense=1"])
+
+
+# ---------------------------------------------------------------------------
+# cross_process_stall finding
+# ---------------------------------------------------------------------------
+def _stall_timeline(wait_ms: float, work_ms: float = 1.0, n: int = 12) -> Timeline:
+    tl = Timeline()
+    t0 = 1000.0
+    for i in range(n):
+        # frequent publication traces (publish + param_apply, 2+ spans each)
+        # ride along: they are NOT paths and must not dilute the stall
+        # majority test
+        pub = tracing.TraceContext(tracing.new_trace_id(), tracing.new_span_id())
+        tl.add(tracing.span_record("publish", "learner", pub, t0 + i, t0 + i + 0.001))
+        tl.add(
+            tracing.span_record(
+                "param_apply", "worker",
+                tracing.TraceContext(pub.trace_id, tracing.new_span_id()),
+                t0 + i, t0 + i + 0.002, worker=0,
+            )
+        )
+        ctx = tracing.TraceContext(tracing.new_trace_id(), tracing.new_span_id())
+        t = t0 + i
+        tl.add(tracing.span_record("env_step", "worker", ctx, t, t + work_ms / 1000.0))
+        tl.add(
+            tracing.span_record(
+                "queue_wait", "worker",
+                tracing.TraceContext(ctx.trace_id, tracing.new_span_id(), ctx.span_id),
+                t, t + wait_ms / 1000.0,
+            )
+        )
+        tl.add(
+            tracing.span_record(
+                "learner_apply", "learner",
+                tracing.TraceContext(ctx.trace_id, tracing.new_span_id(), ctx.span_id),
+                t, t + work_ms / 1000.0,
+            )
+        )
+    return tl
+
+
+def test_cross_process_stall_fires_on_wait_dominated_paths():
+    findings = detect_cross_process_stall(_stall_timeline(wait_ms=50.0))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.code == "cross_process_stall" and f.severity == "warning"
+    assert "worker/queue_wait" in f.title
+    assert f.data["stalled"] == 12
+    # and it rides the ranked detector list
+    codes = [x.code for x in run_detectors(_stall_timeline(wait_ms=50.0))]
+    assert "cross_process_stall" in codes
+
+
+def test_cross_process_stall_quiet_on_healthy_paths():
+    assert detect_cross_process_stall(_stall_timeline(wait_ms=0.1, work_ms=5.0)) == []
+    assert detect_cross_process_stall(Timeline()) == []
+
+
+# ---------------------------------------------------------------------------
+# serving: batcher stage boundaries + gateway propagation
+# ---------------------------------------------------------------------------
+def test_gateway_propagates_traceparent_and_returns_stage_timing(monkeypatch, tmp_path):
+    import time as _time
+
+    from sheeprl_tpu.gateway.broker import SessionBroker
+    from sheeprl_tpu.gateway.gateway import Gateway
+    from sheeprl_tpu.gateway.replica import ReplicaHandle
+    from sheeprl_tpu.telemetry.sinks import JsonlSink
+
+    class _FakeManager:
+        backoff_s = 0.1
+        num_replicas = 1
+        total_respawns = 0
+
+        def __init__(self, handles):
+            self.handles = handles
+
+        def routable(self, include_draining: bool = True):
+            return [h for h in self.handles if h.routable]
+
+        def report_failure(self, replica_id, err=None):
+            pass
+
+        def alive_count(self):
+            return len(self.handles)
+
+        def quarantined_ids(self):
+            return []
+
+    h0 = ReplicaHandle(0)
+    h0.state, h0.port, h0.last_healthy = "running", 10000, _time.monotonic()
+    sink = JsonlSink(str(tmp_path / "gw.jsonl"))
+    gw = Gateway(_FakeManager([h0]), broker=SessionBroker(), sink=sink)
+    seen_bodies = []
+
+    def fake_post(url, body, timeout):
+        seen_bodies.append(body)
+        resp = {"actions": [[1.0]], "session_state": "blob"}
+        if body.get("traceparent"):
+            resp["timing"] = {"batch_queue_ms": 3.0, "jit_step_ms": 1.0, "export_ms": 0.2}
+            resp["trace_id"] = tracing.parse_traceparent(body["traceparent"])[0]
+        return 200, resp, {}
+
+    monkeypatch.setattr(gw, "_post", fake_post)
+    header = tracing.make_traceparent(tracing.new_trace_id(), tracing.new_span_id())
+    status, body, _ = gw.handle_act(
+        {"obs": {"x": [[0.0]]}, "session_id": "a", "traceparent": header}
+    )
+    assert status == 200
+    # the forwarded body carried the gateway's span as the replica's parent,
+    # in the SAME trace the client started
+    fwd = tracing.parse_traceparent(seen_bodies[0]["traceparent"])
+    assert fwd is not None and fwd[0] == tracing.parse_traceparent(header)[0]
+    assert body["trace_id"] == fwd[0]
+    timing = body["timing"]
+    for stage in ("admission_ms", "route_ms", "forward_ms", "broker_put_ms"):
+        assert stage in timing
+    assert timing["replica"]["jit_step_ms"] == 1.0
+    # spans landed on the gateway's stream, schema-valid, joined on trace_id
+    sink.close()
+    assert validate_jsonl(tmp_path / "gw.jsonl") == []
+    spans = [json.loads(ln) for ln in open(tmp_path / "gw.jsonl")]
+    assert {s["name"] for s in spans} == {"admission", "route", "forward", "broker_put"}
+    assert {s["trace_id"] for s in spans} == {fwd[0]}
+    # an untraced request pays none of it
+    status, body, _ = gw.handle_act({"obs": {"x": [[0.0]]}, "session_id": "a"})
+    assert status == 200 and "timing" not in body and "trace_id" not in body
+
+
+# ---------------------------------------------------------------------------
+# LIVE tier-1 fleet smoke: real processes, real streams, full join
+# ---------------------------------------------------------------------------
+def test_live_fleet_run_traces_every_applied_packet():
+    """A real 2-worker SAC fleet run: per-worker streams exist and are
+    schema-valid with role/pid/incarnation heartbeats and a clock
+    handshake; every learner-applied packet's trace_id appears in exactly
+    ONE worker stream; `sheeprl_tpu trace` reconstructs >= 95% of applied
+    packets into complete cross-process paths."""
+    from sheeprl_tpu.cli import run
+
+    run(
+        [
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "metric.log_level=1",
+            "algo.total_steps=96",
+            "algo.learning_starts=16",
+            "algo.per_rank_batch_size=4",
+            "algo.hidden_size=8",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.run_test=False",
+            "buffer.size=4096",
+            "buffer.memmap=False",
+            "checkpoint.every=0",
+            "checkpoint.save_last=True",
+            "model_manager.disabled=True",
+            "seed=3",
+            "run_name=trace_fleet",
+            "algo.fleet.workers=2",
+            "fleet.stats_every_s=0.5",
+        ]
+    )
+    base = Path("logs/runs/sac/continuous_dummy/trace_fleet/version_0")
+    streams = dict(discover_streams(base))
+    assert {"main", "worker_000", "worker_001"} <= set(streams)
+
+    # per-worker streams: schema-valid, role/pid/incarnation heartbeat,
+    # clock handshake answered
+    worker_traces = {}
+    for name in ("worker_000", "worker_001"):
+        path = streams[name]
+        assert validate_jsonl(path) == []
+        events = [json.loads(ln) for ln in open(path)]
+        heartbeat = events[0]
+        assert heartbeat["event"] == "startup" and heartbeat["role"] == "worker"
+        assert heartbeat["pid"] > 0 and heartbeat["incarnation"] == 0
+        clocks = [e for e in events if e["event"] == "clock"]
+        assert clocks and all(abs(c["offset_s"]) < 5.0 for c in clocks)
+        worker_traces[name] = {
+            e["trace_id"] for e in events if e.get("event") == "trace_span" and e.get("name") == "env_step"
+        }
+
+    # the join: every learner-applied packet's trace_id is in exactly one
+    # worker stream (48 rounds x 2 workers = 96 applied packets)
+    main_events = [json.loads(ln) for ln in open(streams["main"])]
+    applied = [
+        e for e in main_events if e.get("event") == "trace_span" and e.get("name") == "learner_apply"
+    ]
+    # 48 rounds for the 96 acked steps, plus any COMPLETE queued rounds the
+    # shutdown drain absorbed (workers produce ahead of the learner) — each
+    # round applies one packet per worker
+    assert len(applied) >= 96 and len(applied) % 2 == 0
+    for span in applied:
+        owners = [n for n, tids in worker_traces.items() if span["trace_id"] in tids]
+        assert len(owners) == 1, f"trace {span['trace_id']} in {owners}"
+
+    # the CLI-level report: >= 95% complete cross-process round paths
+    report = analyze(base)
+    assert report["anchored"]["round"] == len(applied)
+    assert report["completeness"]["round"] >= 0.95
+    assert report["stages"]["worker/env_step"]["count"] >= len(applied)
+    assert report["stages"]["learner/learner_apply"]["count"] == len(applied)
+    assert report["param_apply_lag"] is not None
+    text = render_text(report)
+    assert "reconstructed cross-process" in text
+
+    # doctor merges the same streams without complaint
+    from sheeprl_tpu.diag.doctor import diagnose
+
+    rep = diagnose(base)
+    assert set(rep["process_streams"]) == {"worker_000", "worker_001"}
+    assert rep["clean_shutdown"]
