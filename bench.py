@@ -211,6 +211,12 @@ def bench_dreamer_fleet(which: str) -> dict:
     wall_cap = float(os.environ.get("BENCH_E2E_WALL_S", 950))
     workers = int(os.environ.get("BENCH_FLEET_WORKERS", 2))
     num_envs = int(os.environ.get("BENCH_FLEET_ENVS", max(4, workers)))
+    # BENCH_FLEET_TRANSPORT=socket routes the same recipe over localhost TCP
+    # (fleet.transport=socket, sheeprl_tpu/fleet/net.py). The unit carries
+    # the transport so bench_compare gates socket rounds against socket
+    # rounds only — the two transports have different floors by design.
+    transport = os.environ.get("BENCH_FLEET_TRANSPORT", "mp")
+    unit = "env steps/sec (fleet)" if transport == "mp" else f"env steps/sec (fleet/{transport})"
     return _timed_cli_run(
         [
             f"exp={DREAMER_EXPS[which]}",
@@ -222,6 +228,7 @@ def bench_dreamer_fleet(which: str) -> dict:
             f"algo.total_steps={steps}",
             f"algo.max_wall_time_s={wall_cap}",
             f"algo.fleet.workers={workers}",
+            f"fleet.transport={transport}",
             f"buffer.size={steps}",
             "buffer.checkpoint=False",
             "buffer.memmap=False",
@@ -233,8 +240,9 @@ def bench_dreamer_fleet(which: str) -> dict:
         DREAMER_BASELINE_SECONDS[which],
         DREAMER_TOTAL_STEPS_REF,
         f"Dreamer{which.upper().replace('DV', 'V')} {steps}-step micro-bench policy SPS "
-        f"(same end-to-end recipe through the {workers}-process actor fleet)",
-        unit="env steps/sec (fleet)",
+        f"(same end-to-end recipe through the {workers}-process actor fleet, "
+        f"{transport} transport)",
+        unit=unit,
     )
 
 

@@ -25,6 +25,14 @@ on success — the contract `tests/test_fleet.py::test_fleet_smoke_script_*`
 (slow marker) checks. Run it from any scratch directory:
 
     JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+
+`transport=socket` runs the same murder over the TCP transport
+(`fleet.transport=socket`, sheeprl_tpu/fleet/net.py): workers connect over
+localhost sockets, one is SIGKILLed mid-run, and on top of the mp-mode
+assertions the verdict checks the `net` link stream recorded the dead
+incarnation's disconnect and the respawn's fresh accept:
+
+    JAX_PLATFORMS=cpu python scripts/fleet_smoke.py transport=socket
 """
 from __future__ import annotations
 
@@ -37,7 +45,8 @@ import sys
 import time
 
 TOTAL_STEPS = 1024
-RUN_NAME = "fleet_smoke"
+TRANSPORT = "socket" if "transport=socket" in sys.argv[1:] else "mp"
+RUN_NAME = f"fleet_smoke_{TRANSPORT}"
 BASE = pathlib.Path("logs/runs/sac/continuous_dummy") / RUN_NAME
 
 TRAIN_ARGS = [
@@ -65,6 +74,7 @@ TRAIN_ARGS = [
     f"run_name={RUN_NAME}",
     "fleet.backoff_s=0.1",
     "fleet.stats_every_s=0.5",
+    f"fleet.transport={TRANSPORT}",
 ]
 
 
@@ -161,10 +171,28 @@ def main() -> None:
     tl = Timeline(list(iter_events(telem)))
     codes = [f.code for f in run_detectors(tl)]
 
+    net_summary = {}
+    if TRANSPORT == "socket":
+        # the respawned incarnation must have re-attached over TCP. (A
+        # learner-side `disconnect` net event is NOT asserted: supervisor
+        # crash detection can win the race and close the channel before the
+        # reader thread reports the dead link — the crash event above is the
+        # authoritative record of the murder either way.)
+        net_actions = [e.get("action") for e in events if e.get("event") == "net"]
+        if net_actions.count("accept") < 3:  # 2 initial workers + the respawn
+            _fail("respawned worker never re-attached over the socket", actions=net_actions)
+        net_summary = {
+            "net_accepts": net_actions.count("accept"),
+            "net_disconnects": net_actions.count("disconnect"),
+            "net_reconnects": net_actions.count("reconnect"),
+        }
+
     print(
         json.dumps(
             {
                 "ok": True,
+                "transport": TRANSPORT,
+                **net_summary,
                 "victim_worker": victim_worker,
                 "victim_pid": victim_pid,
                 "respawn_s": round(

@@ -11,6 +11,7 @@ from .donation import UseAfterDonateRule
 from .host_sync import HostSyncRule
 from .retrace import RetraceHazardRule
 from .rng import RngReuseRule
+from .sockets import SocketTimeoutRule
 from .telemetry_schema import TelemetrySchemaRule
 from .threads import ThreadSharedStateRule
 
@@ -21,6 +22,7 @@ RULE_CLASSES = [
     UseAfterDonateRule,
     ThreadSharedStateRule,
     TelemetrySchemaRule,
+    SocketTimeoutRule,
 ]
 
 

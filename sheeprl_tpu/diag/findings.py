@@ -342,6 +342,69 @@ def detect_worker_flap(tl: Timeline, cfg: Any = None) -> List[Finding]:
     ]
 
 
+def detect_link_flap(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Socket-transport reconnect storms (`net` events): each reconnect is a
+    full link cycle — HELLO, replay of unacked frames, dedup work — and a
+    worker reconnecting in a loop stalls its rounds exactly like a flapping
+    process. Windowed by wall clock: ``flap_min`` reconnects by one worker
+    inside ``flap_window_s`` fires the finding, naming the worker and the
+    backoff knob."""
+    flap_min = int(_sel(cfg, "diag.net.flap_min", 3))
+    window_s = float(_sel(cfg, "diag.net.flap_window_s", 60.0))
+    by_worker: Dict[Any, List[float]] = {}
+    for rec in tl.of("net"):
+        if rec.get("action") != "reconnect":
+            continue
+        by_worker.setdefault(rec.get("worker"), []).append(float(rec.get("t") or 0.0))
+    flapping: Dict[Any, int] = {}
+    for worker, times in by_worker.items():
+        times.sort()
+        best = 0
+        lo = 0
+        for hi in range(len(times)):
+            while times[hi] - times[lo] > window_s:
+                lo += 1
+            best = max(best, hi - lo + 1)
+        if best >= flap_min:
+            flapping[worker] = best
+    if not flapping:
+        return []
+    worst_worker, worst = max(flapping.items(), key=lambda kv: kv[1])
+    total = sum(len(v) for v in by_worker.values())
+    return [
+        Finding(
+            code="link_flap",
+            severity="warning",
+            title=(
+                f"fleet link flap: worker {worst_worker} reconnected {worst} time(s) "
+                f"inside {window_s:.0f}s"
+            ),
+            detail=(
+                f"{total} reconnect(s) across {len(by_worker)} worker(s); each one "
+                "replays every unacked frame through learner-side dedup and stalls "
+                "that worker's rounds for the backoff + handshake. A storm usually "
+                "means an unstable route or a peer dropping the link under load, "
+                "not a worker problem."
+            ),
+            remediation=(
+                "Check the worker-side stream for the disconnect reasons (`net` "
+                "disconnect events carry them). Raise `fleet.net.backoff_s` / "
+                "`fleet.net.max_backoff_s` to calm the retry storm, "
+                "`fleet.net.reconnect_grace_s` if the supervisor is converting "
+                "recoverable outages into disconnect faults, and "
+                "`fleet.net.stall_reconnect_s` if healthy-but-slow links are being "
+                "cycled as half-open."
+            ),
+            data={
+                "reconnects": total,
+                "per_worker": {str(k): len(v) for k, v in by_worker.items()},
+                "worst_worker": worst_worker if worst_worker is None else int(worst_worker),
+                "window_s": window_s,
+            },
+        )
+    ]
+
+
 def detect_fleet_degraded(tl: Timeline, cfg: Any = None) -> List[Finding]:
     """Intervals where fewer workers were alive than configured: the run kept
     going (that is the point of the supervision tree) but collected env
@@ -637,6 +700,7 @@ DETECTORS: List[Callable[[Timeline, Any], List[Finding]]] = [
     detect_watchdog_incidents,
     detect_preemption,
     detect_worker_flap,
+    detect_link_flap,
     detect_fleet_degraded,
     detect_quarantine,
     detect_replica_flap,

@@ -345,10 +345,26 @@ class Registry:
                 self.gauge("fleet_dropped_steps", "env steps that never landed").set(
                     float(rec.get("dropped_steps") or 0)
                 )
+                if rec.get("reconnects") is not None:
+                    self.gauge("fleet_reconnects", "cumulative socket reconnects").set(
+                        float(rec.get("reconnects") or 0)
+                    )
+                if rec.get("dup_frames") is not None:
+                    self.gauge(
+                        "fleet_dup_frames", "replayed frames dropped by learner-side dedup"
+                    ).set(float(rec.get("dup_frames") or 0))
             elif action in (
-                "crash", "hang", "torn_packet", "stale_packet", "quarantine", "respawn", "spawn"
+                "crash", "hang", "torn_packet", "stale_packet", "quarantine", "respawn",
+                "spawn", "disconnect",
             ):
                 self.counter(f"fleet_{action}_total", f"fleet worker {action} incidents").inc()
+        elif event == "net":
+            # socket-transport link lifecycle — the action vocabulary is a
+            # closed set (literal at every emit site in fleet/net.py), so
+            # the counter family stays bounded, mirroring the fleet events
+            self.counter(
+                f"net_{rec.get('action', 'event')}_total", "fleet socket link events"
+            ).inc()
         elif event == "chaos":
             self.counter(
                 f"chaos_{rec.get('fault', 'fault')}_total", "injected chaos faults"

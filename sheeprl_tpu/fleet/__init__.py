@@ -2,11 +2,17 @@
 
 The overlap engine (`sheeprl_tpu/engine/`) moved env stepping onto a thread;
 this package moves it onto *processes* — N supervised workers each stepping
-a slice of the vector env and streaming transition packets to the learner
-over bounded queues, with param snapshots flowing the other way (the
-Podracer / parameter-server actor layout, built as a supervision tree from
-day one: crash→respawn, hang→heartbeat escalation, repeated-crasher
-quarantine, SIGTERM drain).
+a slice of the vector env and streaming transition packets to the learner,
+with param snapshots flowing the other way (the Podracer / parameter-server
+actor layout, built as a supervision tree from day one: crash→respawn,
+hang→heartbeat escalation, repeated-crasher quarantine, SIGTERM drain).
+
+Two transports share the same frame format and supervision tree
+(``fleet.transport``): ``mp`` — one-host bounded ``mp.Queue``s — and
+``socket`` — length-prefixed TCP streams (`sheeprl_tpu/fleet/net.py`) with
+stream resync, reconnect/replay/dedup and pull-based param distribution,
+the multi-host layout (workers may attach from remote hosts:
+``python -m sheeprl_tpu.fleet.remote``).
 
 Enable per-run with ``algo.fleet.workers=N`` (sac / dreamer_v3 / ppo);
 tune the supervision knobs under the root ``fleet`` config group and

@@ -46,6 +46,16 @@ __all__ = ["FleetEngine", "FleetRound"]
 _SLEEP_S = 0.001  # round-merge poll granularity
 
 
+def _net_from_cfg(cfg: Any, opt: Any) -> Any:
+    """Build the transport's NetConfig only when the socket transport is
+    selected — the mp path must not pay the import."""
+    if str(opt("fleet.transport", "mp")) != "socket":
+        return None
+    from .net import NetConfig
+
+    return NetConfig.from_cfg(cfg)
+
+
 class FleetRound(NamedTuple):
     packets: List[FleetPacket]  # one per contributing worker, id order
     worker_ids: List[int]
@@ -71,7 +81,10 @@ class FleetEngine:
         fail_window_s: float = 300.0,
         worker_platform: str = "cpu",
         stats_every_s: float = 5.0,
-        drain_timeout_s: float = 10.0,
+        shutdown_drain_s: float = 10.0,
+        transport: str = "mp",
+        net: Any = None,
+        remote_workers: Any = None,
         total_steps: int = 0,
         initial_step: int = 0,
         seed: int = 0,
@@ -91,7 +104,10 @@ class FleetEngine:
         self.fail_window_s = float(fail_window_s)
         self.worker_platform = str(worker_platform)
         self.stats_every_s = float(stats_every_s)
-        self.drain_timeout_s = float(drain_timeout_s)
+        self.shutdown_drain_s = float(shutdown_drain_s)
+        self.transport = str(transport)
+        self.net = net
+        self.remote_workers = list(remote_workers or [])
         self.total_steps = int(total_steps)
         self.telem = telem
         self.guard = guard
@@ -164,7 +180,14 @@ class FleetEngine:
             fail_window_s=float(opt("fleet.fail_window_s", 300.0)),
             worker_platform=str(opt("fleet.worker_platform", "cpu")),
             stats_every_s=float(opt("fleet.stats_every_s", 5.0)),
-            drain_timeout_s=float(opt("fleet.drain_timeout_s", 10.0)),
+            # `fleet.shutdown_drain_s` is the drain budget (the old
+            # `fleet.drain_timeout_s` spelling is honored as a fallback)
+            shutdown_drain_s=float(
+                opt("fleet.shutdown_drain_s", opt("fleet.drain_timeout_s", 10.0))
+            ),
+            transport=str(opt("fleet.transport", "mp")),
+            net=_net_from_cfg(cfg, opt),
+            remote_workers=[int(w) for w in (opt("fleet.net.remote_workers", []) or [])],
             total_steps=total_steps,
             initial_step=initial_step,
             seed=int(opt("seed", 0)),
@@ -200,6 +223,10 @@ class FleetEngine:
             fail_window_s=self.fail_window_s,
             worker_platform=self.worker_platform,
             seed=self.seed,
+            transport=self.transport,
+            net=self.net,
+            remote_workers=self.remote_workers,
+            shutdown_drain_s=self.shutdown_drain_s,
             # workers write their own telemetry streams under the run dir
             # (workers/worker_NNN/); the facade's log_dir is that root —
             # only when telemetry is on at all, so a metrics-off run never
@@ -500,6 +527,11 @@ class FleetEngine:
             "round_wait_s": round(wait_s, 6),
             "interval_s": round(elapsed, 6),
         }
+        if self.sup.net_stats is not None:
+            ns = self.sup.net_stats.snapshot()
+            rec["reconnects"] = int(ns["reconnects"])
+            rec["dup_frames"] = int(ns["dup_frames"])
+            rec["disconnects"] = int(self.sup.disconnects)
         try:
             self.telem.emit(rec)
         except Exception:
@@ -517,7 +549,7 @@ class FleetEngine:
             return 0
         self._stopped = True
         active = self.sup.active_ids()
-        leftovers = self.sup.shutdown(timeout=self.drain_timeout_s)
+        leftovers = self.sup.shutdown(timeout=self.shutdown_drain_s)
         for wid, frames in leftovers.items():
             for frame in frames:
                 try:
@@ -533,6 +565,11 @@ class FleetEngine:
                 drained += int(absorb(rnd) or 0)
                 self.acked_steps += env_steps
                 self.rounds += 1
+        # trailing PARTIAL rounds can't be applied (the round contract needs
+        # one packet per active worker) — they are dropped, but COUNTED: the
+        # drain event carries both the packet count and their env steps so
+        # "the drain discarded work" is an auditable number, never silent
+        leftover_packets = sum(len(dq) for dq in self._pending.values())
         leftover_steps = sum(
             p.env_steps for dq in self._pending.values() for p in dq
         )
@@ -550,6 +587,7 @@ class FleetEngine:
                         "quarantined": len(self.sup.quarantined_ids()),
                         "respawns": int(self.sup.total_respawns),
                         "env_steps": int(drained),
+                        "drain_dropped": int(leftover_packets),
                         "dropped_steps": int(leftover_steps),
                     }
                 )

@@ -26,6 +26,15 @@ with the CRC computed over the pickled payload. A frame whose CRC does not
 match (a torn packet — proved by the chaos layer's byte-flipper) is
 *rejected*, counted, and treated as a worker fault: transitions are never
 silently truncated into the replay buffer.
+
+The frame format is deliberately **wire-shaped**: the same tuples travel
+two transports behind the same channel surface (``fleet.transport``) —
+this module's one-host ``mp.Queue`` channel, and the TCP byte-stream
+channel in :mod:`sheeprl_tpu.fleet.net` (length-prefixed frames, stream
+resync on the CRC boundary, reconnect/replay with learner-side
+``(incarnation, seq)`` dedup). ``encode_packet``/``decode_packet`` are the
+single encode/validate pair for both: the learner re-runs the exact same
+CRC check whether the frame crossed a queue or a network.
 """
 from __future__ import annotations
 

@@ -1,0 +1,109 @@
+"""Standalone remote env worker: attach to a running learner over TCP.
+
+The supervisor normally spawns its workers as local child processes. For a
+slot listed in ``fleet.net.remote_workers`` it instead *waits*: the slot is
+registered with the listener and goes live when a process — typically on
+another host — dials in with this entrypoint:
+
+    python -m sheeprl_tpu.fleet.remote \\
+        --connect LEARNER_HOST:PORT --worker-id 3 --token RUN_TOKEN \\
+        [--log-dir /local/scratch/worker3]
+
+The remote worker needs nothing but the address, its slot id and the run
+token (printed by the learner / carried in the ``net listen`` telemetry
+event): it connects with ``incarnation=-1`` ("assign me") and the
+HELLO_ACK delivers the full run **spec** — program path, config, slot
+count, current incarnation and lifetime seed — so the remote host never
+needs the experiment config shipped out of band. Everything after the
+handshake is the ordinary :func:`~sheeprl_tpu.fleet.worker.fleet_worker_loop`:
+same packets, same heartbeats, same reconnect/replay semantics as a
+locally-spawned socket worker. If the learner quarantines the slot the
+HELLO is refused and this process exits.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Optional
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(description="SheepRL-TPU remote fleet worker")
+    parser.add_argument("--connect", required=True, help="learner listener HOST:PORT")
+    parser.add_argument("--worker-id", required=True, type=int, help="fleet slot to claim")
+    parser.add_argument("--token", required=True, help="run token (fences the fleet)")
+    parser.add_argument(
+        "--log-dir", default=None, help="local telemetry stream dir (default: none)"
+    )
+    parser.add_argument(
+        "--spec-timeout-s",
+        default=30.0,
+        type=float,
+        help="how long to wait for the learner's HELLO_ACK spec",
+    )
+    args = parser.parse_args(argv)
+    host, _, port = args.connect.rpartition(":")
+    if not host or not port.isdigit():
+        parser.error(f"--connect must be HOST:PORT, got {args.connect!r}")
+
+    # remote workers act on host CPU exactly like locally-spawned ones
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("XLA_FLAGS", "--xla_cpu_multi_thread_eigen=false")
+
+    from ..config import Config
+    from .net import WorkerSocketChannel
+    from .worker import _resolve_program, fleet_worker_loop
+
+    sink = None
+    if args.log_dir:
+        from ..telemetry.tracing import open_process_stream
+
+        sink = open_process_stream(args.log_dir, "worker", int(args.worker_id))
+    channel = WorkerSocketChannel(
+        host,
+        int(port),
+        int(args.worker_id),
+        -1,  # "assign me": the learner's HELLO_ACK carries the incarnation
+        str(args.token),
+        emit=(sink.write if sink is not None else None),
+    )
+    deadline = time.monotonic() + float(args.spec_timeout_s)
+    while channel.spec is None and time.monotonic() < deadline:
+        if channel.stop.is_set():  # refused (quarantined slot / bad token)
+            print("[fleet-remote] attach refused by learner", file=sys.stderr)
+            channel.close()
+            return 2
+        time.sleep(0.05)
+    spec = channel.spec
+    if spec is None:
+        print(
+            f"[fleet-remote] no spec within {args.spec_timeout_s:.0f}s "
+            "(is this slot in fleet.net.remote_workers?)",
+            file=sys.stderr,
+        )
+        channel.close()
+        return 3
+    cfg = Config(spec["cfg"])
+    program = _resolve_program(str(spec["program"]))(
+        cfg, int(args.worker_id), int(spec["num_workers"])
+    )
+    if hasattr(program, "lifetime"):
+        program.lifetime = int(spec.get("initial_lifetime", 0))
+    try:
+        fleet_worker_loop(
+            program, channel, None, int(args.worker_id), channel.incarnation, sink
+        )
+    finally:
+        channel.close()
+        if sink is not None:
+            try:
+                sink.close()
+            except Exception:
+                pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

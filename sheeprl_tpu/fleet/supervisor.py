@@ -35,12 +35,14 @@ import pickle
 import random
 import sys
 import time
+import uuid
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from ..resilience.chaos import chaos_from_cfg
 from ..resilience.supervisor import HeartbeatWatchdog
 from ..telemetry import tracing
+from .net import FleetListener, NetConfig, NetStats
 from .protocol import CTRL_CLOCK, CTRL_PARAMS, CTRL_PROFILE, CTRL_STOP, WorkerChannel
 from .worker import worker_entry
 
@@ -104,6 +106,10 @@ class FleetSupervisor:
         seed: int = 0,
         log_dir: Optional[str] = None,
         trace: bool = True,
+        transport: str = "mp",
+        net: Optional[NetConfig] = None,
+        remote_workers: Optional[List[int]] = None,
+        shutdown_drain_s: float = 10.0,
     ):
         self.cfg = cfg
         self.telem = telem
@@ -121,6 +127,17 @@ class FleetSupervisor:
         self.fail_window_s = float(fail_window_s)
         self.worker_platform = str(worker_platform)
         self.seed = int(seed)
+        self.transport = str(transport)
+        if self.transport not in ("mp", "socket"):
+            raise ValueError(f"fleet.transport must be 'mp' or 'socket', got {transport!r}")
+        self.net = net or NetConfig()
+        self.remote_workers = [int(w) for w in (remote_workers or [])]
+        self.shutdown_drain_s = float(shutdown_drain_s)
+        # one listener + shared link counters for the whole fleet (socket
+        # transport only); the token fences this run's workers from strays
+        self.listener: Optional[FleetListener] = None
+        self.net_stats: Optional[NetStats] = None
+        self._net_token = uuid.uuid4().hex
         self._ctx = mp.get_context("spawn")
         self._cfg_dict = cfg.to_dict() if hasattr(cfg, "to_dict") else dict(cfg)
         self.handles: List[WorkerHandle] = [WorkerHandle(i) for i in range(self.num_workers)]
@@ -135,16 +152,24 @@ class FleetSupervisor:
         self.torn_packets = 0
         self.crashes = 0
         self.hangs = 0
+        self.disconnects = 0
         self._stopping = False
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "FleetSupervisor":
+        if self.transport == "socket":
+            self.net_stats = NetStats()
+            self.listener = FleetListener(
+                self.net,
+                self._net_token,
+                stats=self.net_stats,
+                emit=(self.telem.emit if self.telem is not None else None),
+            )
         for handle in self.handles:
             self._spawn(handle)
         return self
 
     def _spawn(self, handle: WorkerHandle) -> None:
-        handle.channel = WorkerChannel(self._ctx, self.queue_depth)
         handle.chaos = chaos_from_cfg(self.cfg, handle.worker_id, run_seed=self.seed)
         if handle.chaos is not None:
             handle.chaos.incarnation = handle.incarnation
@@ -158,6 +183,66 @@ class FleetSupervisor:
             "log_dir": self.log_dir,  # the worker's own telemetry stream root
             "trace": self.trace,
         }
+        remote = handle.worker_id in self.remote_workers
+        if self.transport == "socket":
+            # the learner-side channel is the listener registration; the
+            # child (or a remotely-started worker) dials back with the run
+            # token and this incarnation
+            handle.channel = self.listener.register(
+                handle.worker_id,
+                handle.incarnation,
+                self.queue_depth,
+                # a remote worker gets the whole run spec in its HELLO_ACK
+                # (it connected with nothing but worker_id + token)
+                spec=spec if remote else None,
+            )
+            spec["connect"] = {
+                # children of this process always dial loopback; a 0.0.0.0
+                # bind is for remote workers, not the local spawn path
+                "host": "127.0.0.1" if self.net.host in ("0.0.0.0", "::") else self.net.host,
+                "port": self.listener.port,
+                "token": self._net_token,
+                "incarnation": handle.incarnation,
+                "net": self.net,
+            }
+        else:
+            handle.channel = WorkerChannel(self._ctx, self.queue_depth)
+        if remote:
+            # remote slot: no local process to manage — the slot goes live
+            # when the remote host attaches (spawn_grace_s bounds the wait,
+            # the reconnect grace bounds later link outages)
+            handle.proc = None
+            handle.state = "running"
+            handle.hung_stall = None
+            handle.clock_probed = False
+            handle.spawned_at = time.monotonic()
+            self._ensure_watchdog(handle)
+            handle.watchdog.beat(-1 - handle.incarnation)
+            _emit(
+                self.telem,
+                {
+                    "event": "fleet",
+                    "action": "await_attach",
+                    "step": 0,
+                    "worker": handle.worker_id,
+                    "incarnation": handle.incarnation,
+                    "detail": f"remote slot listening on port {self.listener.port}",
+                },
+            )
+            print(
+                f"[fleet] remote slot {handle.worker_id} waiting — start it with:\n"
+                f"[fleet]   python -m sheeprl_tpu.fleet.remote "
+                f"--connect <this-host>:{self.listener.port} "
+                f"--worker-id {handle.worker_id} --token {self._net_token}",
+                file=sys.stderr,
+                flush=True,
+            )
+            if self._last_params is not None:
+                try:
+                    handle.channel.ctrl.put((CTRL_PARAMS,) + self._last_params)
+                except Exception:
+                    pass
+            return
         # the child inherits os.environ at exec: pin its backend BEFORE the
         # interpreter starts so `import jax` in the child never touches the
         # learner's accelerator (restored immediately — spawn's exec happens
@@ -167,7 +252,11 @@ class FleetSupervisor:
         try:
             handle.proc = self._ctx.Process(
                 target=worker_entry,
-                args=(spec, handle.channel, handle.chaos),
+                args=(
+                    spec,
+                    handle.channel if self.transport == "mp" else None,
+                    handle.chaos,
+                ),
                 name=f"fleet-worker-{handle.worker_id}",
                 daemon=True,
             )
@@ -181,14 +270,7 @@ class FleetSupervisor:
         handle.hung_stall = None
         handle.clock_probed = False
         handle.spawned_at = time.monotonic()
-        if handle.watchdog is None:
-            handle.watchdog = HeartbeatWatchdog(
-                stall_s=self.hang_s,
-                action="none",
-                telem=None,  # the supervisor emits the fleet-scoped event
-                poll_s=max(0.05, min(1.0, self.hang_s / 5.0)),
-                on_stall=self._make_on_stall(handle),
-            ).start()
+        self._ensure_watchdog(handle)
         handle.watchdog.beat(-1 - handle.incarnation)  # fresh epoch per spawn
         _emit(
             self.telem,
@@ -207,6 +289,16 @@ class FleetSupervisor:
                 handle.channel.ctrl.put((CTRL_PARAMS,) + self._last_params)
             except Exception:
                 pass
+
+    def _ensure_watchdog(self, handle: WorkerHandle) -> None:
+        if handle.watchdog is None:
+            handle.watchdog = HeartbeatWatchdog(
+                stall_s=self.hang_s,
+                action="none",
+                telem=None,  # the supervisor emits the fleet-scoped event
+                poll_s=max(0.05, min(1.0, self.hang_s / 5.0)),
+                on_stall=self._make_on_stall(handle),
+            ).start()
 
     def _make_on_stall(self, handle: WorkerHandle) -> Callable[[int, float], None]:
         def on_stall(hb_at_stall: int, stalled_s: float) -> None:
@@ -331,6 +423,36 @@ class FleetSupervisor:
                         exitcode=int(proc.exitcode),
                     )
                     continue
+                if (
+                    self.transport == "socket"
+                    and handle.channel is not None
+                    and handle.channel.ever_connected()
+                    and not self._stopping
+                ):
+                    # a dropped link gets a reconnect window before it is a
+                    # fault: the worker side is busy retrying with jittered
+                    # backoff — only a link down PAST the grace goes through
+                    # the fail-budget → quarantine path
+                    down_s = handle.channel.disconnected_for()
+                    if down_s > self.net.reconnect_grace_s:
+                        self.disconnects += 1
+                        self.fault(
+                            handle,
+                            "disconnect",
+                            step=step,
+                            detail=(
+                                f"link down {down_s:.1f}s > reconnect grace "
+                                f"{self.net.reconnect_grace_s:.0f}s"
+                            ),
+                        )
+                        continue
+                    if down_s > 0:
+                        # heartbeats ride the wire: while the link is down
+                        # (but inside the grace) they CANNOT advance, so the
+                        # hang watchdog must not convert an in-grace outage
+                        # into a SIGKILL — the grace clock governs here
+                        handle.hung_stall = None
+                        continue
                 if handle.channel is not None and handle.watchdog is not None:
                     hb = int(handle.channel.heartbeat.value)
                     if hb <= 0:
@@ -403,6 +525,10 @@ class FleetSupervisor:
         if handle.channel is not None:
             handle.channel.close()
             handle.channel = None
+        if self.listener is not None:
+            # a zombie reconnect from the dead incarnation must be refused
+            # until the respawn re-registers the slot
+            self.listener.unregister(handle.worker_id)
         handle.hung_stall = None
         now = time.monotonic()
         handle.fails.append((now, reason))
@@ -474,10 +600,12 @@ class FleetSupervisor:
         return out
 
     # -- shutdown ----------------------------------------------------------
-    def shutdown(self, timeout: float = 10.0) -> Dict[int, List[Any]]:
+    def shutdown(self, timeout: Optional[float] = None) -> Dict[int, List[Any]]:
         """Stop every worker and return the leftover raw frames per worker
-        (salvage + whatever was still queued) for the engine to drain."""
+        (salvage + whatever was still queued) for the engine to drain. The
+        drain budget defaults to ``fleet.shutdown_drain_s``."""
         self._stopping = True
+        drain_s = self.shutdown_drain_s if timeout is None else float(timeout)
         for handle in self.handles:
             if handle.channel is not None:
                 handle.channel.stop.set()
@@ -486,7 +614,7 @@ class FleetSupervisor:
                 except Exception:
                     pass
         leftovers: Dict[int, List[Any]] = {}
-        deadline = time.monotonic() + timeout
+        deadline = time.monotonic() + drain_s
         for handle in self.handles:
             frames = list(handle.salvage)
             handle.salvage = []
@@ -501,6 +629,14 @@ class FleetSupervisor:
                 if proc.is_alive():
                     proc.kill()
                     proc.join(timeout=5.0)
+            elif handle.channel is not None and handle.state == "running":
+                # remote slot: no process to join — drain what the link
+                # still delivers inside the same budget
+                while time.monotonic() < deadline and handle.channel.connected():
+                    got = handle.channel.drain_data()
+                    if not got:
+                        time.sleep(0.05)
+                    frames.extend(got)
             if handle.channel is not None:
                 frames.extend(handle.channel.drain_data())
                 handle.channel.close()
@@ -512,4 +648,7 @@ class FleetSupervisor:
             if handle.state != "quarantined":
                 handle.state = "stopped"
             leftovers[handle.worker_id] = frames
+        if self.listener is not None:
+            self.listener.close()
+            self.listener = None
         return leftovers

@@ -217,9 +217,12 @@ def fleet_worker_loop(
         channel.heartbeat.value = heartbeat
 
 
-def worker_entry(spec: Dict[str, Any], channel: WorkerChannel, chaos: Optional[Any]) -> None:
+def worker_entry(spec: Dict[str, Any], channel: Optional[WorkerChannel], chaos: Optional[Any]) -> None:
     """Process entrypoint (spawn target). ``spec`` is a plain dict:
-    ``{program, cfg, worker_id, num_workers, incarnation, log_dir?, trace?}``."""
+    ``{program, cfg, worker_id, num_workers, incarnation, log_dir?, trace?,
+    connect?}``. With a ``connect`` block (socket transport) ``channel`` is
+    None and the worker dials the learner's listener instead — the loop
+    itself never knows which transport it is on."""
     worker_id = int(spec["worker_id"])
     incarnation = int(spec["incarnation"])
     sink = None
@@ -240,6 +243,20 @@ def worker_entry(spec: Dict[str, Any], channel: WorkerChannel, chaos: Optional[A
                 os.path.join(os.path.dirname(sink.path), "xprof"),
                 emit=sink.write,
                 role="worker",
+            )
+        connect = spec.get("connect")
+        if channel is None and connect is not None:
+            from .net import WorkerSocketChannel
+
+            channel = WorkerSocketChannel(
+                connect["host"],
+                int(connect["port"]),
+                worker_id,
+                int(connect.get("incarnation", incarnation)),
+                str(connect["token"]),
+                net=connect.get("net"),
+                chaos=chaos,
+                emit=(sink.write if sink is not None else None),
             )
         cfg = Config(spec["cfg"])
         program = _resolve_program(str(spec["program"]))(

@@ -75,6 +75,15 @@ class ChaosInjector:
         torn_workers: Optional[List[int]] = None,
         drop_publication_at: int = 0,
         drop_workers: Optional[List[int]] = None,
+        net_partition_at: int = 0,
+        net_partition_s: float = 2.0,
+        net_corrupt_at: int = 0,
+        net_reset_at: int = 0,
+        net_half_open_at: int = 0,
+        net_half_open_s: float = 2.0,
+        net_latency_ms: float = 0.0,
+        net_jitter_ms: float = 0.0,
+        net_workers: Optional[List[int]] = None,
         seed: int = 0,
     ) -> None:
         self.worker_id = int(worker_id)
@@ -91,6 +100,24 @@ class ChaosInjector:
         self.torn_workers = _as_int_list(torn_workers)
         self.drop_publication_at = int(drop_publication_at)
         self.drop_workers = _as_int_list(drop_workers)
+        # network faults (socket transport, fleet/net.py): thresholds are
+        # DATA-packet sequence numbers — the one counter both sides of the
+        # wire agree on — so a net chaos run replays exactly like the
+        # process faults above
+        self.net_partition_at = int(net_partition_at)
+        self.net_partition_s = float(net_partition_s)
+        self.net_corrupt_at = int(net_corrupt_at)
+        self.net_reset_at = int(net_reset_at)
+        self.net_half_open_at = int(net_half_open_at)
+        self.net_half_open_s = float(net_half_open_s)
+        self.net_latency_ms = float(net_latency_ms)
+        self.net_jitter_ms = float(net_jitter_ms)
+        self.net_workers = _as_int_list(net_workers)
+        self._net_partitioned = False
+        self._net_corrupted = False
+        self._net_reset = False
+        self._net_half_opened = False
+        self._net_rng: Optional[random.Random] = None  # lazy: one stream per injector
         self.seed = int(seed)
         self._hung = False
         # stamped by the supervisor at (re)spawn: without `crash_repeat` an
@@ -159,6 +186,91 @@ class ChaosInjector:
             return bytes(torn)
         return blob
 
+    # -- network hooks (worker-side socket channel, fleet/net.py) ------------
+    def net_partitions(self, packet_seq: int) -> bool:
+        """True exactly once, when the worker is about to transmit packet
+        ``net_partition_at``: the channel severs the link and refuses to
+        reconnect for ``net_partition_s`` seconds (the packet itself is
+        delivered after the reconnect — nothing is lost, only delayed)."""
+        if (
+            self.net_partition_at > 0
+            and packet_seq >= self.net_partition_at
+            and not self._net_partitioned
+            and self._is_target(self.net_workers)
+            and self.incarnation == 0  # a respawned worker proved recovery
+        ):
+            self._net_partitioned = True
+            return True
+        return False
+
+    def net_corrupt_wire(self, wire: bytes, packet_seq: int) -> bytes:
+        """Byte-corrupt the FIRST transmission of packet ``net_corrupt_at``
+        in flight (the clean bytes stay in the worker's replay buffer, so
+        the learner's resync + RESEND recovers the packet uncorrupted)."""
+        if (
+            self.net_corrupt_at > 0
+            and packet_seq == self.net_corrupt_at
+            and not self._net_corrupted
+            and self._is_target(self.net_workers)
+            and self.incarnation == 0
+            and len(wire) > 24
+        ):
+            self._net_corrupted = True
+            rng = random.Random(self.seed * 1_000_003 + self.worker_id * 1013 + packet_seq)
+            torn = bytearray(wire)
+            # flip bytes past the magic so the frame parses far enough to
+            # fail its CRC (not just vanish as line noise)
+            for _ in range(8):
+                torn[rng.randrange(8, len(torn))] ^= 0xFF
+            return bytes(torn)
+        return wire
+
+    def net_resets(self, packet_seq: int) -> bool:
+        """Abruptly drop the connection right AFTER packet ``net_reset_at``
+        was transmitted — the frame is in flight but unacked, so the
+        reconnect replays it and the learner-side dedup must drop it."""
+        if (
+            self.net_reset_at > 0
+            and packet_seq == self.net_reset_at
+            and not self._net_reset
+            and self._is_target(self.net_workers)
+            and self.incarnation == 0
+        ):
+            self._net_reset = True
+            return True
+        return False
+
+    def net_half_opens(self, packet_seq: int) -> bool:
+        """Stop reading from the socket for ``net_half_open_s`` after packet
+        ``net_half_open_at`` — the connection stays ESTABLISHED but credits
+        and ctrl frames pile up unread (the accept-but-never-read peer)."""
+        if (
+            self.net_half_open_at > 0
+            and packet_seq == self.net_half_open_at
+            and not self._net_half_opened
+            and self._is_target(self.net_workers)
+            and self.incarnation == 0
+        ):
+            self._net_half_opened = True
+            return True
+        return False
+
+    def net_delay(self) -> None:
+        """Added per-send latency (+ seeded jitter) on the data path. The
+        jitter stream is seeded ONCE per injector so successive sends draw
+        different offsets (reseeding per call would degenerate jitter into
+        one constant)."""
+        if self.net_latency_ms <= 0 or not self._is_target(self.net_workers):
+            return
+        delay = self.net_latency_ms
+        if self.net_jitter_ms > 0:
+            if self._net_rng is None:
+                self._net_rng = random.Random(
+                    self.seed * 1_000_003 + self.worker_id * 1013
+                )
+            delay += self._net_rng.uniform(0.0, self.net_jitter_ms)
+        time.sleep(delay / 1000.0)
+
     # -- supervisor-side hook ------------------------------------------------
     def drops_publication(self, pub_seq: int) -> bool:
         return (
@@ -176,6 +288,11 @@ class ChaosInjector:
                 self.slow_step_ms and self.slow_every,
                 self.torn_packet_at,
                 self.drop_publication_at,
+                self.net_partition_at,
+                self.net_corrupt_at,
+                self.net_reset_at,
+                self.net_half_open_at,
+                self.net_latency_ms,
             )
         )
 
@@ -202,5 +319,14 @@ def chaos_from_cfg(cfg: Any, worker_id: int, run_seed: int = 0) -> Optional[Chao
         torn_workers=_as_int_list(sel("resilience.chaos.torn_workers", None)),
         drop_publication_at=int(sel("resilience.chaos.drop_publication_at", 0) or 0),
         drop_workers=_as_int_list(sel("resilience.chaos.drop_workers", None)),
+        net_partition_at=int(sel("resilience.chaos.net_partition_at", 0) or 0),
+        net_partition_s=float(sel("resilience.chaos.net_partition_s", 2.0) or 2.0),
+        net_corrupt_at=int(sel("resilience.chaos.net_corrupt_at", 0) or 0),
+        net_reset_at=int(sel("resilience.chaos.net_reset_at", 0) or 0),
+        net_half_open_at=int(sel("resilience.chaos.net_half_open_at", 0) or 0),
+        net_half_open_s=float(sel("resilience.chaos.net_half_open_s", 2.0) or 2.0),
+        net_latency_ms=float(sel("resilience.chaos.net_latency_ms", 0.0) or 0.0),
+        net_jitter_ms=float(sel("resilience.chaos.net_jitter_ms", 0.0) or 0.0),
+        net_workers=_as_int_list(sel("resilience.chaos.net_workers", None)),
         seed=int(run_seed if seed is None else seed),
     )

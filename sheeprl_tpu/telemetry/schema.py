@@ -252,9 +252,34 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "rounds": (False, _NUM),
         "queue_depth_max": (False, _NUM),
         "env_steps": (False, _NUM),
+        # shutdown drain accounting: packets in trailing PARTIAL rounds
+        # that could not be applied (dropped and counted, never silent) +
+        # the env steps they carried
+        "drain_dropped": (False, _NUM),
         "dropped_steps": (False, _NUM),
         "round_wait_s": (False, _NUM),
         "interval_s": (False, _NUM),
+        # socket-transport link totals on the interval snapshot
+        "reconnects": (False, _NUM),
+        "dup_frames": (False, _NUM),
+        "disconnects": (False, _NUM),
+    },
+    # socket-transport link lifecycle (sheeprl_tpu/fleet/net.py): learner
+    # events (listen | accept | reconnect | refuse | disconnect | resync |
+    # dup_frame | gap_resend | write_timeout | pull) on the run stream,
+    # worker events (connect | connect_backoff | disconnect | resend |
+    # partition | chaos_reset | refused) on the worker's own stream.
+    # `doctor` folds reconnect storms into the `link_flap` finding and
+    # Prometheus mirrors every action as `sheeprl_net_<action>_total`.
+    "net": {
+        "action": (True, _STR),
+        "worker": (False, _NUM),
+        "incarnation": (False, _NUM),
+        "seq": (False, _NUM),
+        "version": (False, _NUM),
+        "count": (False, _NUM),
+        "bytes": (False, _NUM),
+        "detail": (False, _STR),
     },
     # deterministic fault injection (resilience/chaos.py): faults the
     # SUPERVISOR injects (worker-side faults surface as `fleet` incidents —

@@ -19,6 +19,7 @@ from sheeprl_tpu.analysis.rules.donation import UseAfterDonateRule
 from sheeprl_tpu.analysis.rules.host_sync import HostSyncRule
 from sheeprl_tpu.analysis.rules.retrace import RetraceHazardRule
 from sheeprl_tpu.analysis.rules.rng import RngReuseRule
+from sheeprl_tpu.analysis.rules.sockets import SocketTimeoutRule
 from sheeprl_tpu.analysis.rules.telemetry_schema import TelemetrySchemaRule
 from sheeprl_tpu.analysis.rules.threads import ThreadSharedStateRule
 
@@ -378,6 +379,81 @@ def test_threads_rule_scoped_to_threaded_subsystems(tmp_path):
     assert findings == []
 
 
+# ------------------------------------------------------- socket-timeout
+SOCKETS_RED = """
+    import socket
+
+    def serve():
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        conn, addr = srv.accept()
+        data = conn.recv(1024)
+        c = socket.create_connection(("host", 80))
+        c.connect(("host", 81))
+        return data
+"""
+
+
+def test_sockets_red(tmp_path):
+    findings, f = _lint(tmp_path, SOCKETS_RED, SocketTimeoutRule(), name="fleet/red.py")
+    assert [x.line for x in findings] == [8, 9, 11]
+    assert all(x.rule_id == "socket-timeout" for x in findings)
+    assert ".accept()" in findings[0].message
+    # accepted sockets do NOT inherit the listener's timeout
+    assert ".recv()" in findings[1].message and "`conn`" in findings[1].message
+    assert ".connect()" in findings[2].message
+
+
+def test_sockets_green_settimeout_helper_and_create_connection(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import socket
+
+        def configure(sock, t):
+            sock.settimeout(t)
+
+        def serve():
+            srv = socket.socket()
+            srv.settimeout(1.0)
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(1)
+            conn, addr = srv.accept()
+            configure(conn, 0.5)
+            data = conn.recv(1024)
+            c = socket.create_connection(("host", 80), timeout=2.0)
+            c.recv(1)
+            return data
+        """,
+        SocketTimeoutRule(),
+        name="serve/green.py",
+    )
+    assert findings == []
+
+
+def test_sockets_settimeout_none_does_not_count(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import socket
+
+        def serve():
+            s = socket.socket()
+            s.settimeout(None)
+            s.recv(1)
+        """,
+        SocketTimeoutRule(),
+        name="gateway/x.py",
+    )
+    assert len(findings) == 1 and findings[0].line == 7
+
+
+def test_sockets_rule_scoped_to_transport_subsystems(tmp_path):
+    findings, _ = _lint(tmp_path, SOCKETS_RED, SocketTimeoutRule(), name="algos/red.py")
+    assert findings == []
+
+
 # ------------------------------------------------- telemetry-schema-drift
 FAKE_SCHEMA = {
     "demo": {"step": (True, int), "detail": (False, str)},
@@ -645,6 +721,7 @@ RED_BY_RULE = {
         11,
     ),
     "thread-shared-state": ("engine/snippet.py", THREADS_RED, 14),
+    "socket-timeout": ("fleet/snippet.py", SOCKETS_RED, 8),
     "telemetry-schema-drift": (
         "snippet.py",
         """

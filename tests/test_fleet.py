@@ -391,14 +391,19 @@ def test_sigterm_drain_with_live_workers_leaves_consistent_checkpoint():
 # process murdered by the OS mid-run, not a chaos-scripted exit
 # ---------------------------------------------------------------------------
 @pytest.mark.slow
-def test_fleet_smoke_script_survives_external_sigkill(tmp_path):
+@pytest.mark.parametrize("transport", ["mp", "socket"])
+def test_fleet_smoke_script_survives_external_sigkill(tmp_path, transport):
     import os
     import subprocess
     import sys
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
-        [sys.executable, os.path.join(repo, "scripts", "fleet_smoke.py")],
+        [
+            sys.executable,
+            os.path.join(repo, "scripts", "fleet_smoke.py"),
+            f"transport={transport}",
+        ],
         stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL,
         text=True,
@@ -409,5 +414,9 @@ def test_fleet_smoke_script_survives_external_sigkill(tmp_path):
     assert proc.stdout.strip(), f"smoke printed nothing (rc={proc.returncode})"
     rec = json.loads(proc.stdout.strip().splitlines()[-1])
     assert proc.returncode == 0 and rec["ok"], rec
+    assert rec["transport"] == transport
     assert rec["final_step"] == 1024  # no env steps lost to the kill
     assert rec["incident_found"], rec  # doctor surfaced the incident
+    if transport == "socket":
+        # the respawned incarnation re-attached over TCP
+        assert rec["net_accepts"] >= 3, rec
