@@ -36,7 +36,11 @@ Comparison rules (normalization — the trajectory is heterogeneous):
   the best comparable prior by more than ``--shed-delta``; a ratio gate is
   meaningless against a 0-shed baseline). Grouping is unit + platform class
   as for BENCH — the unit string carries the session/replica scale, so a
-  1k-session smoke is never judged against a 10k-session run.
+  1k-session smoke is never judged against a 10k-session run. Rounds with
+  the externalized broker (``broker=external`` in the unit) additionally
+  gate broker-failover recovery and replication-lag p95, and ANY nonzero
+  ``acked_loss`` in the newest round's failover/broker leg fails outright —
+  zero acked loss is an invariant, not a trend.
 
 ``--dry-run`` performs the full comparison and prints the report but always
 exits 0 unless the artifacts themselves are unreadable — that keeps the
@@ -79,6 +83,13 @@ SERVE_GATED_FIELDS = (
     ("stage_forward_p95_ms", "gateway→replica forward p95", "lower", "rel"),
     ("stage_jit_step_p95_ms", "replica jit-step p95", "lower", "rel"),
     ("stage_batch_queue_p95_ms", "replica batch-queue p95", "lower", "rel"),
+    # externalized-broker failover leg (--broker external): how long the
+    # standby took to serve after the primary was SIGKILLed, and the
+    # sync-replication wait p95 every acked PUT paid. Skipped automatically
+    # against rounds that never ran the leg (the unit string carries
+    # "broker=external", so these only ever compare like with like).
+    ("broker_recovery_s", "broker failover recovery", "lower", "rel"),
+    ("broker_repl_lag_p95_ms", "broker replication-lag p95", "lower", "rel"),
 )
 # absolute shed-rate increase vs the best comparable prior that fails the gate
 DEFAULT_SHED_DELTA = 0.05
@@ -321,6 +332,31 @@ def compare(
                 fields=SERVE_GATED_FIELDS,
                 abs_delta=shed_delta,
             )
+            # acked loss is not a trend to gate — it is an invariant: ANY
+            # nonzero value in the newest round's failover or broker leg is
+            # a regression regardless of history (rc=1 already marks the
+            # round unusable; this names the reason even if a future writer
+            # forgets to set rc)
+            for leg_name in ("failover", "broker"):
+                leg = newest_s.get(leg_name)
+                loss = leg.get("acked_loss") if isinstance(leg, dict) else None
+                cmp = {
+                    "metric": f"{leg_name}.acked_loss [serve]",
+                    "newest": loss,
+                    "baseline_best": 0,
+                }
+                if loss is None:
+                    cmp["verdict"] = "skipped (leg not run)"
+                elif loss == 0:
+                    cmp["verdict"] = "ok"
+                else:
+                    cmp["verdict"] = "REGRESSION"
+                    report["ok"] = False
+                    report["failures"].append(
+                        f"{leg_name} leg acked_loss={loss} "
+                        f"({newest_s['_file']}) — the zero-acked-loss invariant is broken"
+                    )
+                report["comparisons"].append(cmp)
 
     # the multichip gate runs even with no (usable) BENCH records — a
     # MULTICHIP-only trajectory still has an ok→fail flip to catch

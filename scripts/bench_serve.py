@@ -23,13 +23,26 @@ records the serving SLOs into a schema'd ``SERVE_rNN.json`` next to the
   until the fleet is back to its pre-kill routable width; acked-request
   loss must be zero (the broker replays unacked steps from the last acked
   latent).
+* **broker-failover leg** (``--broker external``) — the session broker is
+  EXTERNALIZED: a primary + standby ``brokerd`` pair (real spawned
+  processes, WAL-durable, sync replication) behind a ``BrokerClient``
+  gateway, and the mid-run SIGKILL hits the PRIMARY BROKER instead of a
+  replica. The standby must promote within its lease, the gateway's
+  broker ops must fail over (shedding, never thread-pinning, in the
+  window), and the per-ack counter continuity check still demands
+  ``acked_loss == 0`` — the ack-after-broker-put contract across a dead
+  source of truth. Recovery time, promotion epoch and the replication /
+  fsync percentiles land in the record (``broker`` + flattened
+  ``broker_recovery_s`` / ``broker_repl_lag_p95_ms``, gated by
+  ``bench_compare.py``).
 
 The smoke used in CI::
 
     python scripts/bench_serve.py --sessions 1000 --replicas 2 \
         --duration-s 20 --workers 32
 
-The full run: ``--sessions 10000 --workers 64 --duration-s 120``.
+The full run: ``--sessions 10000 --workers 64 --duration-s 120``; the
+broker-failover round: ``--broker external --duration-s 30``.
 """
 from __future__ import annotations
 
@@ -63,6 +76,11 @@ class LoadStats:
         self.mismatches = 0  # acked-state loss: action != acked-step count
         self.latencies_ms: List[float] = []
         self.stage_ms: Dict[str, List[float]] = {}
+        # monotonic ack times of SESSION requests (the ones whose ack
+        # requires a broker put): the broker-failover leg measures recovery
+        # as the first session ack after the kill — driver-observed truth,
+        # immune to probe-thread scheduling
+        self.session_ack_t: List[float] = []
 
     def record(
         self,
@@ -70,12 +88,15 @@ class LoadStats:
         dt_s: float,
         mismatch: bool = False,
         timing: Optional[Dict[str, Any]] = None,
+        session: bool = False,
     ) -> None:
         with self._lock:
             self.requests += 1
             if status == 200:
                 self.acked += 1
                 self.latencies_ms.append(dt_s * 1000.0)
+                if session:
+                    self.session_ack_t.append(time.monotonic())
                 if mismatch:
                     self.mismatches += 1
                 if timing:
@@ -85,6 +106,30 @@ class LoadStats:
                 self.shed += 1
             else:
                 self.errors += 1
+
+    def session_ack_gap_after(self, t_mono: float, window_s: float = 60.0) -> float:
+        """The longest stall in session acks that overlaps
+        ``[t_mono, t_mono + window_s]`` — the outage the drivers actually
+        experienced. (A naive "first ack after the kill" undercounts: an
+        in-flight request whose broker put landed BEFORE the kill can ack a
+        millisecond after it.) -1 when no ack ever landed after ``t_mono``."""
+        with self._lock:
+            acks = sorted(self.session_ack_t)
+        if not acks or acks[-1] <= t_mono:
+            return -1.0
+        end = t_mono + window_s
+        worst = 0.0
+        prev = None
+        for t in acks:
+            if t <= t_mono:
+                prev = t
+                continue
+            if prev is not None and prev > end:
+                break
+            start = max(prev if prev is not None else t_mono, t_mono)
+            worst = max(worst, t - start)
+            prev = t
+        return worst
 
     @staticmethod
     def _pct(sorted_vals: List[float], p: float) -> float:
@@ -175,7 +220,11 @@ def closed_loop_worker(
             if status == 200:
                 action = float(body["actions"][0][0])
                 mismatch = action != float(expected[sid])
-                stats.record(200, dt, mismatch=mismatch, timing=body.get("timing"))
+                if mismatch and os.environ.get("BENCH_DEBUG_MISMATCH"):
+                    print(f"[MISMATCH] sid={sid} expected={expected[sid]} got={action} "
+                          f"version={body.get('session_version')} replica={body.get('replica')}",
+                          flush=True)
+                stats.record(200, dt, mismatch=mismatch, timing=body.get("timing"), session=True)
                 expected[sid] = int(action) + 1
             else:
                 stats.record(status, dt)
@@ -257,6 +306,97 @@ def wait_recovered(manager: Any, kill: Dict[str, Any], timeout_s: float = 120.0)
     return -1.0
 
 
+# -- broker topology (--broker external) ---------------------------------------
+def start_broker_pair(args: Any, work_dir: pathlib.Path) -> Dict[str, Any]:
+    """Spawn the primary + standby brokerd processes (WAL-durable, sync
+    replication) and return the topology the gateway config needs."""
+    from sheeprl_tpu.gateway.brokerd import spawn_brokerd
+
+    token = "bench-broker"
+    tele_dir = work_dir / "broker_telemetry"
+    base = {
+        "token": token,
+        "durability": args.broker_durability,
+        "lease_s": args.broker_lease_s,
+        "hb_s": max(0.05, args.broker_lease_s / 8.0),
+        "sync_replication": True,
+        "repl_timeout_s": 2.0,
+        "log_every_s": 1.0,
+        "telemetry_dir": str(tele_dir),
+    }
+    primary_spec = dict(base, role="primary", broker_id=0, wal_dir=str(work_dir / "wal_primary"))
+    primary_proc, primary_port = spawn_brokerd(primary_spec)
+    standby_spec = dict(
+        base,
+        role="standby",
+        broker_id=1,
+        wal_dir=str(work_dir / "wal_standby"),
+        peer=("127.0.0.1", primary_port),
+    )
+    standby_proc, standby_port = spawn_brokerd(standby_spec)
+    return {
+        "token": token,
+        "primary": (primary_proc, primary_port),
+        "standby": (standby_proc, standby_port),
+        "endpoints": [f"127.0.0.1:{primary_port}", f"127.0.0.1:{standby_port}"],
+        "telemetry_dir": tele_dir,
+    }
+
+
+def kill_primary_broker(brokers: Dict[str, Any]) -> Dict[str, Any]:
+    """SIGKILL the primary brokerd mid-load — the source of truth for every
+    pinned session dies the hard way."""
+    proc, port = brokers["primary"]
+    os.kill(proc.pid, signal.SIGKILL)
+    return {"killed": "primary", "pid": proc.pid, "port": port, "t_kill": time.monotonic()}
+
+
+def wait_broker_recovered(gw: Any, kill: Dict[str, Any], timeout_s: float = 60.0) -> float:
+    """Seconds from the SIGKILL until the gateway's broker client reaches a
+    serving PRIMARY again (the standby's promotion, discovered through the
+    client's own failover path); -1 on timeout."""
+    from sheeprl_tpu.gateway.broker_client import BrokerUnavailable
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            if gw.broker.stat().get("role") == "primary":
+                return time.monotonic() - kill["t_kill"]
+        except BrokerUnavailable:
+            pass
+        time.sleep(0.05)
+    return -1.0
+
+
+def broker_telemetry_summary(tele_dir: pathlib.Path) -> Dict[str, Any]:
+    """Fold the brokerd processes' own streams into the record: promotion
+    time, replication-wait p95 high-water, WAL fsync p95 high-water."""
+    import json as _json
+
+    out: Dict[str, Any] = {}
+    for stream in sorted(tele_dir.glob("brokers/broker_*/telemetry.jsonl")):
+        for line in stream.read_text().splitlines():
+            try:
+                rec = _json.loads(line)
+            except _json.JSONDecodeError:
+                continue
+            if rec.get("event") != "broker":
+                continue
+            if rec.get("action") == "promote":
+                out["promotion_s"] = float(rec.get("promotion_s") or 0.0)
+                out["promotion_epoch"] = int(rec.get("epoch") or 0)
+            elif rec.get("action") == "interval":
+                if rec.get("repl_wait_p95_ms") is not None:
+                    out["repl_lag_p95_ms"] = max(
+                        out.get("repl_lag_p95_ms", 0.0), float(rec["repl_wait_p95_ms"])
+                    )
+                if rec.get("fsync_p95_ms") is not None:
+                    out["fsync_p95_ms"] = max(
+                        out.get("fsync_p95_ms", 0.0), float(rec["fsync_p95_ms"])
+                    )
+    return out
+
+
 # -- record --------------------------------------------------------------------
 def next_round(out_dir: pathlib.Path) -> int:
     rounds = [
@@ -298,6 +438,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="admission token-bucket rate (0 = unlimited)")
     ap.add_argument("--failover", dest="failover", action="store_true", default=True)
     ap.add_argument("--no-failover", dest="failover", action="store_false")
+    ap.add_argument("--broker", choices=("inproc", "external"), default="inproc",
+                    help="external = primary+standby brokerd pair behind a BrokerClient; "
+                         "the failover leg then SIGKILLs the PRIMARY BROKER, not a replica")
+    ap.add_argument("--broker-durability", choices=("memory", "wal", "fsync"), default="wal")
+    ap.add_argument("--broker-lease-s", type=float, default=1.0,
+                    help="standby promotion lease (the failover-window budget)")
+    ap.add_argument("--broker-op-timeout-s", type=float, default=2.0,
+                    help="gateway-side per-broker-op deadline (past it: shed, 503)")
     ap.add_argument("--out-dir", default=str(REPO_ROOT))
     ap.add_argument("--telemetry-dir", default="",
                     help="also write gateway telemetry JSONL under this dir")
@@ -328,6 +476,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         telemetry_dir = pathlib.Path(args.telemetry_dir)
         sink = JsonlSink(str(telemetry_dir / "telemetry.jsonl"))
 
+    brokers: Optional[Dict[str, Any]] = None
+    if args.broker == "external":
+        import tempfile
+
+        broker_work = pathlib.Path(
+            str(telemetry_dir) if telemetry_dir else tempfile.mkdtemp(prefix="bench_broker_")
+        )
+        print(
+            f"[bench_serve] starting primary+standby brokerd pair "
+            f"(durability={args.broker_durability}, lease {args.broker_lease_s}s) ...",
+            flush=True,
+        )
+        brokers = start_broker_pair(args, broker_work)
+        cfg.set_path("gateway.broker.mode", "external")
+        cfg.set_path("gateway.broker.endpoints", brokers["endpoints"])
+        cfg.set_path("gateway.broker.token", brokers["token"])
+        cfg.set_path("gateway.broker.op_timeout_s", args.broker_op_timeout_s)
+
+    # failover bookkeeping initialized BEFORE the try: the finally reads it
+    # even when setup itself raises (e.g. the fleet never becomes routable)
+    failover: Dict[str, Any] = {}
+    broker_leg: Dict[str, Any] = {}
+    kill = None
+    broker_kill = None
     t_setup = time.monotonic()
     print(f"[bench_serve] starting {args.replicas} synthetic replicas ...", flush=True)
     gw = build_cluster(cfg, sink=sink, start=True, telemetry_dir=telemetry_dir)
@@ -364,18 +536,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         threads += open_loop_dispatcher(gw, args.open_rate, stats, stop)
 
         t0 = time.monotonic()
-        failover: Dict[str, Any] = {}
-        kill = None
         while time.monotonic() - t0 < args.duration_s:
             time.sleep(0.25)
-            if args.failover and kill is None and time.monotonic() - t0 >= args.duration_s / 2:
-                kill = kill_one_replica(manager)
-                if kill:
+            if args.failover and time.monotonic() - t0 >= args.duration_s / 2:
+                if args.broker == "external" and broker_kill is None:
+                    # the broker-failover leg: the source of truth for every
+                    # pinned session dies mid-load, not a replica
+                    broker_kill = kill_primary_broker(brokers)
                     print(
-                        f"[bench_serve] t+{time.monotonic() - t0:.1f}s: SIGKILL replica "
-                        f"{kill['killed_replica']} (pid {kill['pid']})",
+                        f"[bench_serve] t+{time.monotonic() - t0:.1f}s: SIGKILL primary "
+                        f"brokerd (pid {broker_kill['pid']})",
                         flush=True,
                     )
+                elif args.broker == "inproc" and kill is None:
+                    kill = kill_one_replica(manager)
+                    if kill:
+                        print(
+                            f"[bench_serve] t+{time.monotonic() - t0:.1f}s: SIGKILL replica "
+                            f"{kill['killed_replica']} (pid {kill['pid']})",
+                            flush=True,
+                        )
         if kill:
             recovery_s = wait_recovered(manager, kill)
             failover = {
@@ -392,6 +572,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         for t in threads:
             t.join(timeout=10.0)
         duration_s = time.monotonic() - t0
+        if broker_kill:
+            # recovery = the session-ack gap the drivers actually observed
+            # (session acks require a broker put, so the outage window is
+            # exactly the gap); the role poll afterwards — uncontended now
+            # that the drivers stopped — confirms the standby truly serves
+            # as primary, not just that one op slipped through
+            recovery_s = stats.session_ack_gap_after(broker_kill["t_kill"])
+            promoted_s = wait_broker_recovered(gw, broker_kill)
+            if promoted_s < 0:
+                recovery_s = -1.0  # the standby never took over: failed leg
+            broker_leg = {
+                "mode": "external",
+                "durability": args.broker_durability,
+                "killed": "primary",
+                "recovery_s": round(recovery_s, 3),
+                "acked_loss": stats.snapshot()["mismatches"],
+            }
+            print(
+                f"[bench_serve] broker failover: first session ack "
+                f"{recovery_s:.2f}s after the SIGKILL, acked loss "
+                f"{broker_leg['acked_loss']}",
+                flush=True,
+            )
     finally:
         stop_err = None
         try:
@@ -399,12 +602,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         except Exception as e:  # shutdown must not eat the record
             stop_err = e
         manager.shutdown()
+        if brokers is not None:
+            # fold the daemons' own telemetry in BEFORE terminating them
+            # (close() flushes their final interval snapshot)
+            for role in ("primary", "standby"):
+                proc, _port = brokers[role]
+                if proc.is_alive():
+                    proc.terminate()
+                    proc.join(timeout=10.0)
+            if broker_kill:
+                broker_leg.update(broker_telemetry_summary(brokers["telemetry_dir"]))
         if sink is not None:
             sink.close()
 
     snap = stats.snapshot()
     stages = stats.stage_percentiles()
     unit = f"gateway act p95 ms ({args.sessions} sessions x {args.replicas} replicas)"
+    if args.broker == "external":
+        # the externalized-broker topology is a DIFFERENT system (every ack
+        # pays a broker round-trip + replication): its rounds gate against
+        # each other, never against the inproc trajectory
+        unit += ", broker=external"
     value = round(stats.percentile(0.95), 3)
     best_prior = prior_best_p95(pathlib.Path(args.out_dir), unit)
     shed_rate = snap["shed"] / snap["requests"] if snap["requests"] else 0.0
@@ -414,6 +632,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"gateway load bench: {args.sessions} sticky sessions, "
             f"{args.replicas} synthetic replicas, closed+open loop"
             + (", 1 replica SIGKILLed mid-run" if failover else "")
+            + (
+                ", external broker pair with the primary SIGKILLed mid-run"
+                if broker_leg
+                else (", external broker pair" if args.broker == "external" else "")
+            )
         ),
         "value": value,
         "unit": unit,
@@ -444,6 +667,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 record[f"stage_{stage}_p95_ms"] = stages[stage]["p95_ms"]
     if failover:
         record["failover"] = failover
+    if broker_leg:
+        record["broker"] = broker_leg
+        if broker_leg.get("recovery_s", -1) >= 0:
+            record["broker_recovery_s"] = broker_leg["recovery_s"]
+        if broker_leg.get("repl_lag_p95_ms") is not None:
+            record["broker_repl_lag_p95_ms"] = round(broker_leg["repl_lag_p95_ms"], 3)
     problems = validate_event(record)
     if problems:
         print(f"[bench_serve] SCHEMA-INVALID record: {problems}", file=sys.stderr)
@@ -451,10 +680,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     out_dir = pathlib.Path(args.out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
     round_n = next_round(out_dir)
+    broker_recovered = not broker_leg or broker_leg.get("recovery_s", -1.0) >= 0
     wrapper = {
         "n": round_n,
         "cmd": "python scripts/bench_serve.py " + " ".join(argv or sys.argv[1:]),
-        "rc": 0 if not problems and snap["mismatches"] == 0 else 1,
+        "rc": 0 if not problems and snap["mismatches"] == 0 and broker_recovered else 1,
         "parsed": record,
     }
     out_path = out_dir / f"SERVE_r{round_n:02d}.json"
@@ -477,6 +707,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f" | failover: recovery {failover['recovery_s']}s "
                 f"acked_loss={failover['acked_loss']}"
                 if failover
+                else ""
+            )
+            + (
+                f" | broker failover: recovery {broker_leg['recovery_s']}s "
+                f"promotion={broker_leg.get('promotion_s', 'n/a')}s "
+                f"acked_loss={broker_leg['acked_loss']}"
+                if broker_leg
                 else ""
             ),
             flush=True,

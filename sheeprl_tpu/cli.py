@@ -294,6 +294,25 @@ def gateway(args: Optional[Sequence[str]] = None) -> None:
     gateway_from_checkpoint(ckpt_path, cfg)
 
 
+def brokerd(args: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl_tpu brokerd [gateway.broker.listen_port=7070
+    gateway.broker.role=standby gateway.broker.peer=host:7070 ...]` — run
+    one externalized session-broker daemon (gateway/brokerd.py): the
+    WAL-durable, primary/standby-replicated source of truth for sticky
+    sessions, spoken to by gateways running `gateway.broker.mode=external`.
+    Start the primary first, then the standby with `role=standby
+    peer=<primary host:port>`; the standby tails the primary's WAL stream
+    and promotes itself (fencing the zombie) when the lease expires."""
+    argv = list(args if args is not None else sys.argv[1:])
+    from .config.compose import CONFIG_ROOT
+
+    cfg = Config({"gateway": load_config_file(CONFIG_ROOT / "gateway" / "default.yaml").to_dict()})
+    _apply_cli_overrides(cfg, argv)
+    from .gateway.brokerd import run_brokerd_from_cfg
+
+    run_brokerd_from_cfg(cfg)
+
+
 def resume(args: Optional[Sequence[str]] = None) -> None:
     """`sheeprl_tpu resume run_dir=<logs/runs/.../version_N> [key=value ...]`
     — relaunch a preempted/crashed run from its newest complete checkpoint
@@ -422,11 +441,11 @@ def available_agents() -> None:
 
 
 def main() -> None:
-    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|gateway|doctor|trace|lint|registration|agents> ...`"""
+    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|gateway|brokerd|doctor|trace|lint|registration|agents> ...`"""
     argv = sys.argv[1:]
     if argv and argv[0] in (
-        "run", "eval", "evaluation", "resume", "serve", "gateway", "doctor", "trace",
-        "lint", "registration", "agents",
+        "run", "eval", "evaluation", "resume", "serve", "gateway", "brokerd", "doctor",
+        "trace", "lint", "registration", "agents",
     ):
         cmd, rest = argv[0], argv[1:]
     else:
@@ -441,6 +460,8 @@ def main() -> None:
         serve(rest)
     elif cmd == "gateway":
         gateway(rest)
+    elif cmd == "brokerd":
+        brokerd(rest)
     elif cmd == "doctor":
         doctor(rest)
     elif cmd == "trace":

@@ -544,6 +544,120 @@ def detect_replica_flap(tl: Timeline, cfg: Any = None) -> List[Finding]:
     ]
 
 
+def detect_broker_failover(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """A session-broker standby promoted itself: the primary's lease
+    expired (SIGKILL, partition, or a zombie that stopped heartbeating).
+    The system surviving is the design working — but every promotion is a
+    real outage window (writes shed until the standby took over) and any
+    fenced zombie writes deserve a human look, so it always surfaces."""
+    promotes = [rec for rec in tl.of("broker") if rec.get("action") == "promote"]
+    if not promotes:
+        return []
+    fenced = [rec for rec in tl.of("broker") if rec.get("action") == "fenced"]
+    demotes = [rec for rec in tl.of("broker") if rec.get("action") == "demote"]
+    sync_failed = [rec for rec in tl.of("broker") if rec.get("action") == "sync_failed"]
+    worst_s = max(float(rec.get("promotion_s") or 0.0) for rec in promotes)
+    epochs = sorted(int(rec.get("epoch") or 0) for rec in promotes)
+    return [
+        Finding(
+            code="broker_failover",
+            # always a warning: a promotion is the design working, and the
+            # stream's sync_failed events are recoverable resyncs (the
+            # standby bootstraps fresh), not proof of durability loss —
+            # they're surfaced in the data/detail for the human to weigh
+            severity="warning",
+            title=(
+                f"session-broker failover: {len(promotes)} standby promotion(s) "
+                f"(worst took {worst_s:.2f}s past the last heartbeat)"
+                + (f"; {len(fenced)} zombie write(s) FENCED" if fenced else "")
+            ),
+            detail=(
+                f"Promotion epoch(s) {epochs}; {len(fenced)} lower-epoch replication "
+                f"push(es) rejected by the fencing token and {len(demotes)} node(s) "
+                f"demoted. Writes issued during the promotion window were shed "
+                f"(503 broker_unavailable) and replayed idempotently — acked state "
+                f"never regressed."
+                + (
+                    f" {len(sync_failed)} replication resync(s) occurred (a standby "
+                    "restarted its tail via bootstrap) — check broker_lag if frequent."
+                    if sync_failed
+                    else ""
+                )
+            ),
+            remediation=(
+                "Check why the primary's lease expired (its stderr, OOM-kill, "
+                "network partition). Start a NEW standby against the promoted "
+                "primary (`sheeprl_tpu brokerd gateway.broker.role=standby "
+                "gateway.broker.peer=<promoted host:port>`) — a promoted standby "
+                "runs un-replicated until one attaches. Tune "
+                "`gateway.broker.lease_s` if promotions fire on healthy-but-slow "
+                "heartbeats."
+            ),
+            data={
+                "promotions": len(promotes),
+                "promotion_s_worst": round(worst_s, 3),
+                "epochs": epochs,
+                "fenced_writes": len(fenced),
+                "demotes": len(demotes),
+                "sync_failed": len(sync_failed),
+            },
+        )
+    ]
+
+
+def detect_broker_lag(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Broker durability/replication falling behind the serving plane: the
+    replication-lag high-water, the sync-ack wait p95 or the WAL fsync p95
+    crossing its threshold. Each acked PUT pays these on the request path,
+    so a slow broker IS gateway latency (and, past the op deadline, shed
+    traffic)."""
+    lag_records = int(_sel(cfg, "diag.broker.lag_records", 64))
+    wait_ms = float(_sel(cfg, "diag.broker.repl_wait_p95_ms", 250.0))
+    fsync_ms = float(_sel(cfg, "diag.broker.fsync_p95_ms", 50.0))
+    intervals = [rec for rec in tl.of("broker") if rec.get("action") == "interval"]
+    if not intervals:
+        return []
+    lag_high = max(int(rec.get("lag") or 0) for rec in intervals)
+    wait_high = max(float(rec.get("repl_wait_p95_ms") or 0.0) for rec in intervals)
+    fsync_high = max(float(rec.get("fsync_p95_ms") or 0.0) for rec in intervals)
+    over = []
+    if lag_high >= lag_records:
+        over.append(f"replication lag high-water {lag_high} records (>= {lag_records})")
+    if wait_high >= wait_ms:
+        over.append(f"sync-ack wait p95 {wait_high:.0f} ms (>= {wait_ms:.0f})")
+    if fsync_high >= fsync_ms:
+        over.append(f"WAL fsync p95 {fsync_high:.1f} ms (>= {fsync_ms:.0f})")
+    if not over:
+        return []
+    return [
+        Finding(
+            code="broker_lag",
+            severity="warning",
+            title=f"session-broker lag: {over[0]}" + (f" (+{len(over) - 1} more)" if len(over) > 1 else ""),
+            detail=(
+                "; ".join(over)
+                + ". Every acked PUT waits for durability (and, with sync "
+                "replication, the standby's ack) on the request path."
+            ),
+            remediation=(
+                "A slow standby link wants a closer standby or "
+                "`gateway.broker.sync_replication=False` (accepting the "
+                "acked-loss window a SIGKILLed primary then has). High fsync "
+                "p95 wants `gateway.broker.durability=wal` (SIGKILL-safe, not "
+                "power-loss-safe) or faster disks. Past the op deadline the "
+                "gateway sheds with `broker_unavailable` — check that counter "
+                "in the gateway stats."
+            ),
+            data={
+                "lag_high": lag_high,
+                "repl_wait_p95_ms_high": round(wait_high, 3),
+                "fsync_p95_ms_high": round(fsync_high, 3),
+                "intervals": len(intervals),
+            },
+        )
+    ]
+
+
 def detect_gateway_shedding(tl: Timeline, cfg: Any = None) -> List[Finding]:
     """Sustained admission-control shedding: occasional sheds are the system
     working as designed; a high shed fraction means the fleet is
@@ -704,6 +818,8 @@ DETECTORS: List[Callable[[Timeline, Any], List[Finding]]] = [
     detect_fleet_degraded,
     detect_quarantine,
     detect_replica_flap,
+    detect_broker_failover,
+    detect_broker_lag,
     detect_gateway_shedding,
     detect_cross_process_stall,
     detect_incomplete_stream,

@@ -365,6 +365,37 @@ class Registry:
             self.counter(
                 f"net_{rec.get('action', 'event')}_total", "fleet socket link events"
             ).inc()
+        elif event == "broker":
+            # externalized session broker (gateway/brokerd.py): the action
+            # vocabulary is a closed set (literal at every emit site), so
+            # the sheeprl_broker_* counter family stays bounded; the
+            # periodic interval snapshot mirrors as gauges instead
+            action = rec.get("action")
+            if action == "interval":
+                self.gauge("broker_sessions", "sessions held by the broker").set(
+                    float(rec.get("sessions") or 0)
+                )
+                self.gauge("broker_epoch", "broker fencing epoch").set(
+                    float(rec.get("epoch") or 0)
+                )
+                self.gauge(
+                    "broker_repl_lag_records", "replication lag high-water (records)"
+                ).set(float(rec.get("lag") or 0))
+                self.gauge(
+                    "broker_fenced_writes", "zombie-primary writes rejected (cumulative)"
+                ).set(float(rec.get("fenced_writes") or 0))
+                if rec.get("repl_wait_p95_ms") is not None:
+                    self.gauge(
+                        "broker_repl_wait_p95_ms", "sync-replication ack wait p95 (ms)"
+                    ).set(float(rec["repl_wait_p95_ms"]))
+                if rec.get("fsync_p95_ms") is not None:
+                    self.gauge(
+                        "broker_wal_fsync_p95_ms", "WAL fsync p95 (ms)"
+                    ).set(float(rec["fsync_p95_ms"]))
+            else:
+                self.counter(
+                    f"broker_{action or 'event'}_total", "session-broker lifecycle events"
+                ).inc()
         elif event == "chaos":
             self.counter(
                 f"chaos_{rec.get('fault', 'fault')}_total", "injected chaos faults"

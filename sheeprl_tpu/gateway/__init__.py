@@ -17,12 +17,16 @@ See ``howto/serving.md`` ("Scaling out with the gateway").
 """
 from .admission import AdmissionController, Shed
 from .broker import SessionBroker
-from .cluster import build_cluster, gateway_from_checkpoint
+from .broker_client import BrokerClient, BrokerUnavailable
+from .cluster import build_broker, build_cluster, gateway_from_checkpoint
 from .gateway import Gateway, GatewayStats, NoReplicasAvailable, Router
 from .replica import ReplicaHandle, ReplicaManager, replica_entry, synthetic_counter_core
+from .wal import WalStore
 
 __all__ = [
     "AdmissionController",
+    "BrokerClient",
+    "BrokerUnavailable",
     "Gateway",
     "GatewayStats",
     "NoReplicasAvailable",
@@ -31,6 +35,8 @@ __all__ = [
     "Router",
     "SessionBroker",
     "Shed",
+    "WalStore",
+    "build_broker",
     "build_cluster",
     "gateway_from_checkpoint",
     "replica_entry",

@@ -14,6 +14,14 @@ Entries are ``(version, blob)``: ``version`` is a per-session monotonic
 counter (how many acked steps the broker has absorbed), ``blob`` the opaque
 base64 codec string (`serve/session_codec.py`) exactly as the replica
 produced it — the gateway never decodes latents, it routes them.
+
+This class is the plain IN-PROCESS implementation (``gateway.broker.mode=
+inproc`` without a WAL) — everything here dies with the gateway process and
+an LRU eviction is forever. The durable/replicated variants share its
+surface: :class:`~sheeprl_tpu.gateway.wal.WalStore` (WAL-backed, rehydrates
+evicted-but-durable sessions) and
+:class:`~sheeprl_tpu.gateway.broker_client.BrokerClient` (the externalized
+``brokerd`` daemon pair). ``cluster.build_broker`` picks one from config.
 """
 from __future__ import annotations
 
@@ -44,9 +52,12 @@ class SessionBroker:
                 self.evictions += 1
             return version
 
-    def get(self, sid: str) -> Optional[Tuple[int, str]]:
+    def get(self, sid: str, at_version: int = 0) -> Optional[Tuple[int, str]]:
         """The newest (version, blob) for a session, bumping its recency;
-        None for sessions the broker has never acked (or has evicted)."""
+        None for sessions the broker has never acked (or has evicted).
+        ``at_version`` exists for surface parity with the durable brokers
+        and is ignored here: an in-process put is atomic with the ack, so
+        the newest entry is by construction the last ACKED one."""
         with self._lock:
             entry = self._entries.get(str(sid))
             if entry is not None:

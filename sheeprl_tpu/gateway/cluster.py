@@ -14,7 +14,61 @@ from .broker import SessionBroker
 from .gateway import Gateway
 from .replica import ReplicaManager
 
-__all__ = ["build_cluster", "gateway_from_checkpoint"]
+__all__ = ["build_broker", "build_cluster", "gateway_from_checkpoint"]
+
+
+def build_broker(cfg: Any, sink: Any = None) -> Any:
+    """The ``gateway.broker.mode`` switch — one builder for every consumer:
+
+    * ``inproc`` (default, behavior preserved): the classic in-process
+      LRU :class:`SessionBroker`; with ``gateway.broker.wal_dir`` set it is
+      a WAL-backed :class:`~sheeprl_tpu.gateway.wal.WalStore` instead, so
+      LRU-evicted-but-durable sessions rehydrate from the log and the map
+      survives a gateway restart;
+    * ``external``: a :class:`~sheeprl_tpu.gateway.broker_client.BrokerClient`
+      against running ``sheeprl_tpu brokerd`` daemon(s)
+      (``gateway.broker.endpoints``, primary first then standby) — the
+      topology that lets N gateways share one session plane and survive a
+      SIGKILLed broker via standby promotion.
+    """
+    sel = cfg.select if hasattr(cfg, "select") else (lambda p, d=None: d)
+    mode = str(sel("gateway.broker.mode", "inproc") or "inproc")
+    max_sessions = int(sel("gateway.broker.max_sessions", 1_000_000))
+    emit = sink.write if sink is not None else None
+    if mode == "external":
+        from .broker_client import BrokerClient
+
+        raw = sel("gateway.broker.endpoints", None) or []
+        endpoints = []
+        for ep in raw:
+            host, _, port = str(ep).rpartition(":")
+            endpoints.append((host or "127.0.0.1", int(port)))
+        if not endpoints:
+            raise ValueError(
+                "gateway.broker.mode=external needs gateway.broker.endpoints "
+                "(['host:port', ...] — primary first, standby second)"
+            )
+        return BrokerClient(
+            endpoints,
+            token=str(sel("gateway.broker.token", "sheeprl-broker")),
+            op_timeout_s=float(sel("gateway.broker.op_timeout_s", 2.0)),
+            emit=emit,
+        )
+    if mode != "inproc":
+        raise ValueError(f"unknown gateway.broker.mode '{mode}' (inproc | external)")
+    wal_dir = sel("gateway.broker.wal_dir", None)
+    if wal_dir:
+        from .wal import WalStore
+
+        return WalStore(
+            wal_dir=wal_dir,
+            max_sessions=max_sessions,
+            durability=str(sel("gateway.broker.durability", "wal")),
+            compact_bytes=int(sel("gateway.broker.compact_bytes", 64 * 1024 * 1024)),
+            text=True,
+            emit=emit,
+        )
+    return SessionBroker(max_sessions)
 
 
 def build_cluster(
@@ -79,7 +133,7 @@ def build_cluster(
     )
     gateway = Gateway(
         manager,
-        broker=SessionBroker(int(sel("gateway.broker.max_sessions", 1_000_000))),
+        broker=build_broker(cfg, sink=sink),
         admission=AdmissionController(
             rate_per_s=float(sel("gateway.admission.rate_per_s", 0.0) or 0.0),
             burst=int(sel("gateway.admission.burst", 256)),

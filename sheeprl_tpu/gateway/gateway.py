@@ -36,6 +36,13 @@ stateless and the hidden step is not healed. Closing this fully needs
 replica-side request idempotency keys; in practice the replica's own
 ``request_timeout_s`` abandons queued work on the same deadline, so the
 window requires a single policy step to outlast the forward timeout.
+The external-broker in-doubt-put protection shares the same first-request
+edge: an abandoned broker put is healed by marking the pin suspect and
+rehydrating at the last ACKED version, but a session whose FIRST ack never
+happened has no pin to mark (and a suspect pin can be LRU-evicted) — if
+that one in-doubt put actually landed, the retry rehydrates the broker's
+newest (unacked) state. Both windows need the same replica-side
+idempotency keys to close completely.
 
 Endpoints mirror the single-replica PolicyServer so clients cannot tell the
 difference: ``POST /v1/act``, ``GET /healthz`` (fleet view), ``GET /stats``
@@ -53,6 +60,7 @@ from ..serve.batcher import jittered_retry_after
 from ..telemetry import tracing
 from .admission import AdmissionController, Shed
 from .broker import SessionBroker
+from .broker_client import BrokerUnavailable
 from .replica import ReplicaHandle, ReplicaManager
 
 __all__ = ["Gateway", "GatewayStats", "NoReplicasAvailable", "Router"]
@@ -79,6 +87,7 @@ class GatewayStats:
         self.expired = 0
         self.lost = 0
         self.retries = 0
+        self.broker_unavailable = 0
         self.registry = Registry(prefix="sheeprl_gateway")
         self._m_requests = self.registry.counter("requests_total", "act requests received")
         self._m_acked = self.registry.counter("acked_total", "requests acknowledged (200)")
@@ -90,6 +99,9 @@ class GatewayStats:
         self._m_rehydrates = self.registry.counter("rehydrates_total", "broker state re-hydrations sent")
         self._m_expired = self.registry.counter("expired_total", "410 session_expired seen from replicas")
         self._m_lost = self.registry.counter("lost_total", "stateful sessions with no recoverable latent")
+        self._m_broker_unavailable = self.registry.counter(
+            "broker_unavailable_total", "requests shed because a broker op missed its deadline"
+        )
         self._m_latency = self.registry.histogram(
             "latency_ms", "gateway end-to-end act latency (ms)", LATENCY_MS_BUCKETS
         )
@@ -140,6 +152,11 @@ class GatewayStats:
             self.lost += 1
         self._m_lost.inc()
 
+    def record_broker_unavailable(self) -> None:
+        with self._lock:
+            self.broker_unavailable += 1
+        self._m_broker_unavailable.inc()
+
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             out = {
@@ -152,6 +169,7 @@ class GatewayStats:
                 "expired": self.expired,
                 "lost": self.lost,
                 "retries": self.retries,
+                "broker_unavailable": self.broker_unavailable,
             }
         for name, p in (("p50_ms", 0.50), ("p95_ms", 0.95), ("p99_ms", 0.99)):
             out[name] = round(self._m_latency.percentile(p), 3)
@@ -180,11 +198,17 @@ class Router:
         self.manager = manager
         self.max_pins = int(max_pins)
         self._lock = threading.Lock()
-        # sid -> (replica_id, incarnation, stateful); a respawned replica has
-        # a fresh (empty) cache, so the incarnation is part of the pin;
-        # `stateful` records whether any ack ever carried a latent blob —
-        # what distinguishes a recoverable migration from a lost session
-        self._pins: "OrderedDict[str, Tuple[int, int, bool]]" = OrderedDict()
+        # sid -> (replica_id, incarnation, stateful, acked_version, suspect);
+        # a respawned replica has a fresh (empty) cache, so the incarnation
+        # is part of the pin; `stateful` records whether any ack ever
+        # carried a latent blob — what distinguishes a recoverable migration
+        # from a lost session; `acked_version` is the broker version of the
+        # last ACKED put (what a rehydrate must ask for — the broker may be
+        # one in-doubt, never-acked put ahead); `suspect` marks a pin whose
+        # broker put was abandoned mid-op: the replica cache holds an
+        # unacked step, so the next request MUST rehydrate from the acked
+        # version instead of trusting the cache
+        self._pins: "OrderedDict[str, Tuple[int, int, bool, int, bool]]" = OrderedDict()
         self._rr = 0  # round-robin cursor for sessionless traffic
         self._load: Dict[int, int] = {}  # replica_id -> pinned-session count
 
@@ -217,20 +241,37 @@ class Router:
         if pin is not None:
             for handle in candidates:
                 if (handle.replica_id, handle.incarnation) == pin[:2]:
-                    return handle, False, False
+                    # a suspect pin stays where it is, but its cache holds
+                    # an UNACKED step: force a rehydrate from the acked state
+                    return handle, bool(pin[4]), False
         # new session, or its replica died/respawned/drained: (re)place it
         placeable = self.manager.routable(include_draining=False) or candidates
         if not placeable:
             raise NoReplicasAvailable("no routable replica")
         return self._pick(placeable), True, pin is not None
 
-    def confirm(self, sid: str, handle: ReplicaHandle, stateful: bool = False) -> None:
+    def confirm(
+        self,
+        sid: str,
+        handle: ReplicaHandle,
+        stateful: bool = False,
+        version: Optional[int] = None,
+    ) -> None:
         """Commit the pin after a successful forward: ``handle``'s cache now
         provably holds the session's latest latent. ``stateful`` marks acks
-        whose response carried a latent blob (sticky once set)."""
+        whose response carried a latent blob (sticky once set);
+        ``version`` is the broker version that ack produced (carried so a
+        later rehydrate can ask for exactly the acked state). Confirming
+        clears any ``suspect`` mark — the ack resolved the in-doubt put."""
         with self._lock:
             old = self._pins.get(sid)
-            new = (handle.replica_id, handle.incarnation, bool(stateful) or (old is not None and old[2]))
+            new = (
+                handle.replica_id,
+                handle.incarnation,
+                bool(stateful) or (old is not None and old[2]),
+                int(version) if version is not None else (old[3] if old is not None else 0),
+                False,
+            )
             self._pins[sid] = new
             self._pins.move_to_end(sid)
             if old is not None and old[0] != handle.replica_id:
@@ -247,6 +288,25 @@ class Router:
         with self._lock:
             pin = self._pins.get(sid)
             return pin is not None and pin[2]
+
+    def acked_version(self, sid: str) -> int:
+        """The broker version of this session's last ACKED put (0 when
+        unknown — a fresh/evicted pin): what a rehydrate asks the broker
+        for, so an in-doubt put one version ahead is never served as if it
+        had been acked."""
+        with self._lock:
+            pin = self._pins.get(sid)
+            return pin[3] if pin is not None else 0
+
+    def mark_suspect(self, sid: str) -> None:
+        """The broker put for this session's latest forward was abandoned
+        mid-op (broker unavailable): the replica cache now holds an UNACKED
+        step and the broker may or may not have absorbed it. Until an ack
+        resolves it, every route must rehydrate from the acked version."""
+        with self._lock:
+            pin = self._pins.get(sid)
+            if pin is not None:
+                self._pins[sid] = pin[:4] + (True,)
 
     def unpin(self, sid: str) -> None:
         with self._lock:
@@ -265,7 +325,7 @@ class Gateway:
     def __init__(
         self,
         manager: ReplicaManager,
-        broker: Optional[SessionBroker] = None,
+        broker: Any = None,  # SessionBroker | WalStore | BrokerClient
         admission: Optional[AdmissionController] = None,
         host: str = "127.0.0.1",
         port: int = 0,
@@ -452,7 +512,19 @@ class Gateway:
                 body["session_id"] = sid
                 body["return_state"] = True
                 if needs_state or force_state:
-                    entry = self.broker.get(sid)
+                    try:
+                        # ask for the state AT the last acked version: the
+                        # broker may be one in-doubt (applied-but-unacked)
+                        # put ahead, and serving that state would skip an
+                        # acked step on the client's trajectory
+                        entry = self.broker.get(
+                            sid, at_version=self.router.acked_version(sid)
+                        )
+                    except BrokerUnavailable as e:
+                        # the broker missed its op deadline BEFORE any step
+                        # ran: degrade to shed — a slow broker must cost the
+                        # client a bounded 503, never a pinned request thread
+                        return self._broker_shed(t0, "get", e)
                     if entry is not None:
                         body["session_state"] = entry[1]
                         self.stats.record_rehydrate()
@@ -499,12 +571,31 @@ class Gateway:
                 if sid is not None:
                     if blob is not None:
                         t_put0 = time.monotonic()
-                        resp["session_version"] = self.broker.put(sid, blob)
+                        try:
+                            resp["session_version"] = self.broker.put(sid, blob)
+                        except BrokerUnavailable as e:
+                            # the replica DID step but the put's outcome is
+                            # unknown (it may have been applied with the ack
+                            # lost) — acking would break the ack-after-
+                            # broker-put contract. Mark the pin suspect: the
+                            # next request rehydrates the replica from the
+                            # last ACKED version (rewinding the cache's
+                            # unacked step, and refusing the broker's newest
+                            # if the in-doubt put did land). Shed this one.
+                            self.router.mark_suspect(sid)
+                            return self._broker_shed(t0, "put", e)
                         if trace is not None:
                             trace["stages"]["broker_put"] = (t_put0, time.monotonic())
                     # the ack — not the routing decision — is what proves the
-                    # replica's cache holds the session now
-                    self.router.confirm(sid, handle, stateful=blob is not None)
+                    # replica's cache holds the session now; the version
+                    # rides along so a later rehydrate can name the acked
+                    # state exactly
+                    self.router.confirm(
+                        sid,
+                        handle,
+                        stateful=blob is not None,
+                        version=resp.get("session_version"),
+                    )
                     if migrated:
                         self.stats.record_migration()
                 resp["replica"] = handle.replica_id
@@ -525,6 +616,25 @@ class Gateway:
             502,
             {"error": f"all {self.max_attempts} forward attempts failed", "last_error": last_err},
             {},
+        )
+
+    def _broker_shed(
+        self, t0: float, op: str, err: BaseException
+    ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        """A broker op missed ``gateway.broker.op_timeout_s``: answer 503
+        with a jittered Retry-After (the broker client already burned the
+        op deadline, so the thread was bounded end to end)."""
+        self.stats.record_broker_unavailable()
+        self.stats.record_outcome(time.monotonic() - t0, acked=False)
+        retry = jittered_retry_after(0.5)
+        return (
+            503,
+            {
+                "error": f"session broker unavailable ({op}): {err}",
+                "reason": "broker_unavailable",
+                "retry_after_s": round(retry, 3),
+            },
+            {"Retry-After": f"{max(1, int(round(retry)))}"},
         )
 
     def _finish_trace(

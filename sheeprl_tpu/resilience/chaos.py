@@ -84,6 +84,11 @@ class ChaosInjector:
         net_latency_ms: float = 0.0,
         net_jitter_ms: float = 0.0,
         net_workers: Optional[List[int]] = None,
+        broker_kill_at: int = 0,
+        broker_partition_at: int = 0,
+        broker_partition_s: float = 2.0,
+        broker_torn_wal_at: int = 0,
+        broker_zombie_at: int = 0,
         seed: int = 0,
     ) -> None:
         self.worker_id = int(worker_id)
@@ -113,6 +118,16 @@ class ChaosInjector:
         self.net_latency_ms = float(net_latency_ms)
         self.net_jitter_ms = float(net_jitter_ms)
         self.net_workers = _as_int_list(net_workers)
+        # session-broker faults (gateway/brokerd.py + broker_client.py):
+        # kill/torn-WAL/zombie thresholds are WAL sequence numbers (the one
+        # counter primary, standby and recovery all agree on); the client
+        # partition threshold is the client's own op counter
+        self.broker_kill_at = int(broker_kill_at)
+        self.broker_partition_at = int(broker_partition_at)
+        self.broker_partition_s = float(broker_partition_s)
+        self.broker_torn_wal_at = int(broker_torn_wal_at)
+        self.broker_zombie_at = int(broker_zombie_at)
+        self._broker_partitioned = False
         self._net_partitioned = False
         self._net_corrupted = False
         self._net_reset = False
@@ -271,6 +286,39 @@ class ChaosInjector:
             delay += self._net_rng.uniform(0.0, self.net_jitter_ms)
         time.sleep(delay / 1000.0)
 
+    # -- broker hooks (gateway/brokerd.py server, broker_client.py client) ---
+    def broker_kills(self, wal_seq: int) -> bool:
+        """True when the daemon should hard-die (``os._exit``) instead of
+        applying WAL record ``broker_kill_at`` — the deterministic stand-in
+        for the bench's external SIGKILL of the primary."""
+        return self.broker_kill_at > 0 and wal_seq >= self.broker_kill_at
+
+    def broker_tears_wal(self, wal_seq: int) -> bool:
+        """True when only a PREFIX of record ``broker_torn_wal_at`` should
+        reach disk before the process dies mid-write — what recovery's
+        torn-tail truncation exists to absorb."""
+        return self.broker_torn_wal_at > 0 and wal_seq == self.broker_torn_wal_at
+
+    def broker_zombies(self, wal_seq: int) -> bool:
+        """True once the primary should STOP heartbeating while continuing
+        to serve — the zombie whose post-promotion write the standby's
+        fencing epoch must reject."""
+        return self.broker_zombie_at > 0 and wal_seq >= self.broker_zombie_at
+
+    def broker_partitions(self, op_count: int) -> bool:
+        """True exactly once, when the client is about to issue op
+        ``broker_partition_at``: the client severs its link and refuses to
+        reconnect for ``broker_partition_s`` — the op must then either meet
+        its deadline (shed) or replay idempotently after the heal."""
+        if (
+            self.broker_partition_at > 0
+            and op_count >= self.broker_partition_at
+            and not self._broker_partitioned
+        ):
+            self._broker_partitioned = True
+            return True
+        return False
+
     # -- supervisor-side hook ------------------------------------------------
     def drops_publication(self, pub_seq: int) -> bool:
         return (
@@ -293,6 +341,10 @@ class ChaosInjector:
                 self.net_reset_at,
                 self.net_half_open_at,
                 self.net_latency_ms,
+                self.broker_kill_at,
+                self.broker_partition_at,
+                self.broker_torn_wal_at,
+                self.broker_zombie_at,
             )
         )
 
@@ -328,5 +380,10 @@ def chaos_from_cfg(cfg: Any, worker_id: int, run_seed: int = 0) -> Optional[Chao
         net_latency_ms=float(sel("resilience.chaos.net_latency_ms", 0.0) or 0.0),
         net_jitter_ms=float(sel("resilience.chaos.net_jitter_ms", 0.0) or 0.0),
         net_workers=_as_int_list(sel("resilience.chaos.net_workers", None)),
+        broker_kill_at=int(sel("resilience.chaos.broker_kill_at", 0) or 0),
+        broker_partition_at=int(sel("resilience.chaos.broker_partition_at", 0) or 0),
+        broker_partition_s=float(sel("resilience.chaos.broker_partition_s", 2.0) or 2.0),
+        broker_torn_wal_at=int(sel("resilience.chaos.broker_torn_wal_at", 0) or 0),
+        broker_zombie_at=int(sel("resilience.chaos.broker_zombie_at", 0) or 0),
         seed=int(run_seed if seed is None else seed),
     )

@@ -281,6 +281,39 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "bytes": (False, _NUM),
         "detail": (False, _STR),
     },
+    # externalized session broker (sheeprl_tpu/gateway/wal.py + brokerd.py +
+    # broker_client.py): `action` is either a discrete incident — daemon
+    # side: listen | accept | refuse | standby_attach | standby_detach |
+    # tail_attach | sync_failed | promote (standby took over; promotion_s =
+    # seconds past the last heartbeat) | fenced (a zombie primary's late
+    # write rejected by the fencing epoch) | demote | zombie | repl_timeout;
+    # WAL side: wal_torn_tail (recovery truncated a torn record) |
+    # wal_rehydrate (LRU-evicted-but-durable session re-read from the log) |
+    # rehydrate_failed | compact; client side: client_reconnect |
+    # client_failover | client_partition — or "interval", the periodic
+    # daemon snapshot (sessions, replication lag high-water, sync-wait and
+    # WAL-fsync p95s). Prometheus mirrors every action as
+    # `sheeprl_broker_<action>_total`; doctor folds the stream into the
+    # broker_failover and broker_lag findings.
+    "broker": {
+        "action": (True, _STR),
+        "role": (False, _STR),  # primary | standby | demoted
+        "epoch": (False, _NUM),  # the fencing token
+        "seq": (False, _NUM),  # WAL sequence number
+        "version": (False, _NUM),
+        "sessions": (False, _NUM),
+        "puts": (False, _NUM),
+        "gets": (False, _NUM),
+        "fenced_writes": (False, _NUM),
+        "standbys": (False, _NUM),
+        "lag": (False, _NUM),  # replication lag high-water (records)
+        "count": (False, _NUM),
+        "bytes": (False, _NUM),
+        "promotion_s": (False, _NUM),
+        "repl_wait_p95_ms": (False, _NUM),
+        "fsync_p95_ms": (False, _NUM),
+        "detail": (False, _STR),
+    },
     # deterministic fault injection (resilience/chaos.py): faults the
     # SUPERVISOR injects (worker-side faults surface as `fleet` incidents —
     # a chaos crash is indistinguishable from a real one by design)
@@ -332,6 +365,7 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "expired": (False, _NUM),
         "lost": (False, _NUM),
         "retries": (False, _NUM),
+        "broker_unavailable": (False, _NUM),
         "p50_ms": (False, _NUM),
         "p95_ms": (False, _NUM),
         "p99_ms": (False, _NUM),
@@ -378,6 +412,15 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "stage_forward_p95_ms": (False, _NUM),
         "stage_jit_step_p95_ms": (False, _NUM),
         "stage_batch_queue_p95_ms": (False, _NUM),
+        # broker-failover leg (--broker external): the externalized-broker
+        # topology and what the mid-load SIGKILL of the primary cost.
+        # `broker` holds {mode, durability, killed, promotion_s, recovery_s,
+        # repl_lag_p95_ms, acked_loss}; the flattened fields are what
+        # bench_compare.py gates (recovery/lag lower-is-better, acked_loss
+        # absolutely zero).
+        "broker": (False, _DICT),
+        "broker_recovery_s": (False, _NUM),
+        "broker_repl_lag_p95_ms": (False, _NUM),
     },
 }
 
