@@ -42,6 +42,15 @@ Comparison rules (normalization — the trajectory is heterogeneous):
   ``acked_loss`` in the newest round's failover/broker leg fails outright —
   zero acked loss is an invariant, not a trend.
 
+* `FLYWHEEL_*.json` (scripts/bench_flywheel.py — the end-to-end data-flywheel
+  round): the headline value is **ingest samples/sec** (direction: higher,
+  declared on the record), with lower-is-better gates on the
+  capture-enabled act p95, the ABSOLUTE capture-overhead fraction and the
+  reload-to-first-improved-act lag; ANY nonzero ``acked_loss`` across the
+  rolling reload fails outright (an invariant, like the serve failover
+  legs). rc!=0 rounds are unusable, and rounds predating the flywheel have
+  no FLYWHEEL artifacts at all, so the gate auto-skips against them.
+
 ``--dry-run`` performs the full comparison and prints the report but always
 exits 0 unless the artifacts themselves are unreadable — that keeps the
 lint entry point honest (a rotten gate fails loudly) without letting a
@@ -91,8 +100,22 @@ SERVE_GATED_FIELDS = (
     ("broker_recovery_s", "broker failover recovery", "lower", "rel"),
     ("broker_repl_lag_p95_ms", "broker replication-lag p95", "lower", "rel"),
 )
+# FLYWHEEL_*.json (scripts/bench_flywheel.py — the end-to-end data-flywheel
+# round): the headline value is ingest samples/sec (direction: higher, the
+# record declares it), capture cost and reload lag gate lower-is-better.
+# Rounds predating the flywheel carry none of these files, so the gate
+# auto-skips until the first FLYWHEEL round lands; within the trajectory a
+# field missing on either side is skipped like every other gate.
+FLYWHEEL_GATED_FIELDS = (
+    ("value", "flywheel ingest samples/sec", "higher", "rel"),
+    ("capture_act_p95_ms", "capture-enabled act p95", "lower", "rel"),
+    ("capture_overhead_frac", "capture overhead on act p95", "lower", "abs"),
+    ("reload_to_fresh_act_s", "reload-to-first-improved-act lag", "lower", "rel"),
+)
 # absolute shed-rate increase vs the best comparable prior that fails the gate
 DEFAULT_SHED_DELTA = 0.05
+# absolute capture-overhead-fraction increase that fails the flywheel gate
+DEFAULT_OVERHEAD_DELTA = 0.05
 
 
 def _round_of(path: Path) -> int:
@@ -140,6 +163,30 @@ def load_serve_trajectory(bench_dir: Any) -> List[Dict[str, Any]]:
             wrapper = json.loads(path.read_text())
         except (OSError, json.JSONDecodeError) as err:
             raise RuntimeError(f"unreadable serve-bench artifact {path}: {err}")
+        parsed = wrapper.get("parsed") if isinstance(wrapper, dict) else None
+        rec = dict(parsed) if isinstance(parsed, dict) else {}
+        rec["_round"] = _round_of(path)
+        rec["_file"] = path.name
+        rec["_rc"] = wrapper.get("rc") if isinstance(wrapper, dict) else None
+        rec["_usable"] = bool(parsed) and wrapper.get("rc") == 0 and rec.get("value") is not None
+        out.append(rec)
+    return out
+
+
+def load_flywheel_trajectory(bench_dir: Any) -> List[Dict[str, Any]]:
+    """All readable FLYWHEEL_*.json records (the end-to-end data-flywheel
+    round), oldest first — same wrapper format and bookkeeping as the BENCH
+    trajectory. A round whose wrapper carries ``rc != 0`` (schema-invalid
+    record, nonzero acked loss across the reload, capture overhead past the
+    in-round budget, or a reload that never served fresh params) is
+    unusable, exactly like a crashed bench round."""
+    bench_dir = Path(bench_dir)
+    out: List[Dict[str, Any]] = []
+    for path in sorted(bench_dir.glob("FLYWHEEL_*.json"), key=_round_of):
+        try:
+            wrapper = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as err:
+            raise RuntimeError(f"unreadable flywheel-bench artifact {path}: {err}")
         parsed = wrapper.get("parsed") if isinstance(wrapper, dict) else None
         rec = dict(parsed) if isinstance(parsed, dict) else {}
         rec["_round"] = _round_of(path)
@@ -267,6 +314,8 @@ def compare(
     multichip: Optional[List[Dict[str, Any]]] = None,
     serve: Optional[List[Dict[str, Any]]] = None,
     shed_delta: float = DEFAULT_SHED_DELTA,
+    flywheel: Optional[List[Dict[str, Any]]] = None,
+    overhead_delta: float = DEFAULT_OVERHEAD_DELTA,
 ) -> Dict[str, Any]:
     """Gate the newest usable record against the best comparable prior one.
     Returns {ok, failures[], comparisons[], note?}."""
@@ -358,6 +407,55 @@ def compare(
                     )
                 report["comparisons"].append(cmp)
 
+    # the flywheel gate is its own trajectory too: FLYWHEEL_*.json rounds
+    # judged only against each other (per unit + platform class). Rounds
+    # predating the flywheel simply don't exist in this trajectory, so the
+    # gate auto-skips (a note, never a failure) until the first round lands.
+    if flywheel:
+        if not flywheel[-1]["_usable"]:
+            report["ok"] = False
+            report["failures"].append(
+                f"newest flywheel round {flywheel[-1]['_file']} is unusable "
+                f"(rc={flywheel[-1]['_rc']}) — schema-invalid record, nonzero acked "
+                "loss across the reload, or capture overhead past the in-round budget"
+            )
+        usable_fw = [r for r in flywheel if r["_usable"]]
+        if usable_fw:
+            newest_f = usable_fw[-1]
+            priors_f = [r for r in usable_fw[:-1] if _comparable(newest_f, r)]
+            report["newest_flywheel"] = {
+                "file": newest_f["_file"],
+                "unit": newest_f.get("unit"),
+                "platform_class": platform_class(newest_f),
+            }
+            _gate_fields(
+                report,
+                newest_f,
+                priors_f,
+                threshold,
+                newest_f["_file"],
+                unit="flywheel",
+                fields=FLYWHEEL_GATED_FIELDS,
+                abs_delta=overhead_delta,
+            )
+            # zero acked loss across the rolling reload is an invariant,
+            # exactly like the serve failover legs — ANY nonzero value in
+            # the newest round fails regardless of history
+            loss = newest_f.get("acked_loss")
+            cmp = {"metric": "acked_loss [flywheel]", "newest": loss, "baseline_best": 0}
+            if loss is None:
+                cmp["verdict"] = "skipped (not recorded)"
+            elif loss == 0:
+                cmp["verdict"] = "ok"
+            else:
+                cmp["verdict"] = "REGRESSION"
+                report["ok"] = False
+                report["failures"].append(
+                    f"flywheel round acked_loss={loss} ({newest_f['_file']}) — the "
+                    "zero-acked-loss-across-reload invariant is broken"
+                )
+            report["comparisons"].append(cmp)
+
     # the multichip gate runs even with no (usable) BENCH records — a
     # MULTICHIP-only trajectory still has an ok→fail flip to catch
 
@@ -396,20 +494,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         records = load_trajectory(args.dir)
         multichip = load_multichip(args.dir)
         serve = load_serve_trajectory(args.dir)
+        flywheel = load_flywheel_trajectory(args.dir)
     except RuntimeError as err:
         print(f"[bench_compare] {err}", file=sys.stderr)
         return 1
-    if not records and not multichip and not serve:
+    if not records and not multichip and not serve and not flywheel:
         print(f"[bench_compare] no BENCH_*.json under {args.dir}; nothing to gate", file=sys.stderr)
         return 0
     report = compare(records, threshold=args.threshold, multichip=multichip,
-                     serve=serve, shed_delta=args.shed_delta)
+                     serve=serve, shed_delta=args.shed_delta, flywheel=flywheel)
 
     if args.json:
         print(json.dumps(report, indent=1))
     else:
         print(f"bench gate over {len(records)} BENCH + {len(multichip)} MULTICHIP "
-              f"+ {len(serve)} SERVE records (threshold {args.threshold:.0%})")
+              f"+ {len(serve)} SERVE + {len(flywheel)} FLYWHEEL records "
+              f"(threshold {args.threshold:.0%})")
         if report.get("note"):
             print(f"  note: {report['note']}")
         if report.get("newest"):
@@ -418,6 +518,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         if report.get("newest_serve"):
             n = report["newest_serve"]
             print(f"  newest serve: {n['file']} unit={n['unit']!r} platform_class={n['platform_class']}")
+        if report.get("newest_flywheel"):
+            n = report["newest_flywheel"]
+            print(f"  newest flywheel: {n['file']} unit={n['unit']!r} platform_class={n['platform_class']}")
         for cmp in report["comparisons"]:
             print(f"  {cmp['metric']}: newest={cmp['newest']} baseline_best={cmp['baseline_best']} "
                   f"-> {cmp['verdict']}")

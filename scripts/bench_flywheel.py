@@ -1,0 +1,445 @@
+#!/usr/bin/env python
+"""Data-flywheel end-to-end bench: one full round of the closed loop.
+
+Drives the whole flywheel through the REAL serving stack (spawned synthetic
+replica processes behind the gateway — the same fleet bench_serve drives):
+
+1. **baseline leg** — serve ``--sessions`` sticky sessions with capture OFF
+   and record the act p95 (the denominator of the capture-overhead gate);
+2. **capture leg** — the same fleet with ``serve.capture`` ON: every acked
+   act is appended to the replicas' capture segments, keyed by the
+   request's trace id and stamped with the serving ``params_version``;
+3. **ingest** — ``flywheel/ingest.py`` streams the rotated segments into a
+   replay buffer (exactly-once ledger, torn lines counted) and the bench
+   records **ingest samples/sec** (the headline metric) and the trace-join
+   fraction (every sample must name its gateway request);
+4. **fine-tune** — one ``flywheel/recipe.py`` burst on the ingested buffer
+   (the registered synthetic_counter step), checkpointed as
+   ``ckpt_<N>.ckpt`` beside the seed checkpoint;
+5. **rolling reload** — the recipe pushes the new checkpoint through the
+   gateway's drain-one-replica-at-a-time reload path while the closed-loop
+   drivers KEEP RUNNING: per-ack counter continuity is verified across the
+   swap (any skipped/replayed step is a counted mismatch — ``acked_loss``
+   must be 0) and the bench measures **reload-to-first-improved-act lag**
+   (trigger → first ack served by the bumped ``params_version``).
+
+The record lands in ``FLYWHEEL_rNN.json`` (schema'd ``flywheel_bench``
+event), gated run-over-run by ``scripts/bench_compare.py``: ingest
+samples/sec higher-is-better, capture p95 / overhead fraction / reload lag
+lower-is-better, acked loss an absolute invariant. rc=1 when the record is
+schema-invalid, any acked loss was observed, the capture overhead exceeds
+``--overhead-budget`` (default 10%), any ingested sample failed to join a
+trace id, or the reload never served fresh params.
+
+The smoke used in CI::
+
+    python scripts/bench_flywheel.py --sessions 100 --replicas 2 \
+        --duration-s 5 --post-reload-s 5 --workers 8
+
+The full round: ``--sessions 1000 --workers 32 --duration-s 30``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+class ActStats:
+    """Thread-safe act latency + continuity counters for one serving leg."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.acked = 0
+        self.errors = 0
+        self.shed = 0
+        self.mismatches = 0
+        self.latencies_ms: List[float] = []
+        # (monotonic ack time, params_version) of every ack: the reload-lag
+        # measurement scans for the first ack with the bumped version
+        self.version_acks: List[tuple] = []
+
+    def record(self, status: int, dt_s: float, mismatch: bool = False, version: Optional[int] = None) -> None:
+        with self._lock:
+            self.requests += 1
+            if status == 200:
+                self.acked += 1
+                self.latencies_ms.append(dt_s * 1000.0)
+                if mismatch:
+                    self.mismatches += 1
+                if version is not None:
+                    self.version_acks.append((time.monotonic(), int(version)))
+            elif status == 503:
+                self.shed += 1
+            else:
+                self.errors += 1
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            lat = sorted(self.latencies_ms)
+        if not lat:
+            return 0.0
+        idx = min(len(lat) - 1, max(0, int(round(p * (len(lat) - 1)))))
+        return lat[idx]
+
+    def first_ack_at_version(self, after_mono: float, version: int) -> float:
+        """Seconds from ``after_mono`` to the first ack served by
+        ``params_version >= version``; -1 when none landed."""
+        with self._lock:
+            acks = list(self.version_acks)
+        for t, v in acks:
+            if t >= after_mono and v >= version:
+                return t - after_mono
+        return -1.0
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "requests": self.requests,
+                "acked": self.acked,
+                "errors": self.errors,
+                "shed": self.shed,
+                "mismatches": self.mismatches,
+            }
+
+
+def closed_loop_worker(
+    gw: Any,
+    sessions: List[str],
+    expected: Dict[str, int],
+    stats: ActStats,
+    stop: threading.Event,
+    traced: bool = True,
+) -> None:
+    """Step this worker's sessions round-robin with counter-continuity
+    verification (the synthetic policy echoes its pre-step counter) and a
+    client-reported reward so captured samples carry the full record."""
+    from sheeprl_tpu.telemetry.tracing import make_traceparent, new_span_id, new_trace_id
+
+    while not stop.is_set():
+        for sid in sessions:
+            if stop.is_set():
+                return
+            payload: Dict[str, Any] = {
+                "obs": {"x": [[float(expected[sid])]]},
+                "session_id": sid,
+                "reward": 1.0,
+            }
+            if traced:
+                payload["traceparent"] = make_traceparent(new_trace_id(), new_span_id())
+            t0 = time.monotonic()
+            try:
+                status, body, _ = gw.handle_act(payload)
+            except Exception:
+                stats.record(500, time.monotonic() - t0)
+                continue
+            dt = time.monotonic() - t0
+            if status == 200:
+                action = float(body["actions"][0][0])
+                mismatch = action != float(expected[sid])
+                stats.record(200, dt, mismatch=mismatch, version=body.get("params_version"))
+                expected[sid] = int(action) + 1
+            else:
+                stats.record(status, dt)
+                if status == 503:
+                    time.sleep(min(0.05, float(body.get("retry_after_s") or 0.05)))
+
+
+def run_serving_leg(
+    cfg: Any,
+    sessions: int,
+    workers: int,
+    duration_s: float,
+    telemetry_dir: Optional[pathlib.Path],
+    sink: Any,
+    after_started: Any = None,
+) -> Dict[str, Any]:
+    """Spin up a synthetic fleet, drive the closed loop for ``duration_s``,
+    optionally hand control to ``after_started(gw, stats, stop, expected)``
+    mid-run (the flywheel turn), tear down, return the leg's numbers."""
+    from sheeprl_tpu.gateway.cluster import build_cluster
+
+    gw = build_cluster(cfg, sink=sink, start=True, telemetry_dir=telemetry_dir)
+    manager = gw.manager
+    out: Dict[str, Any] = {}
+    try:
+        want = int(cfg.select("gateway.replicas", 2))
+        if len(manager.routable()) < want:
+            raise RuntimeError(f"fleet not routable: {len(manager.routable())}/{want}")
+        stats = ActStats()
+        stop = threading.Event()
+        expected: Dict[str, int] = {f"s{i:06d}": 0 for i in range(sessions)}
+        sids = list(expected)
+        threads: List[threading.Thread] = []
+        for w in range(workers):
+            slice_ = sids[w::workers]
+            if not slice_:
+                continue
+            t = threading.Thread(
+                target=closed_loop_worker,
+                args=(gw, slice_, expected, stats, stop),
+                daemon=True,
+                name=f"fw-closed-{w}",
+            )
+            t.start()
+            threads.append(t)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < duration_s:
+            time.sleep(0.1)
+        if after_started is not None:
+            out.update(after_started(gw, stats, stop))
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        out["duration_s"] = time.monotonic() - t0
+        out["p95_ms"] = round(stats.percentile(0.95), 3)
+        out["p50_ms"] = round(stats.percentile(0.50), 3)
+        out.update(stats.snapshot())
+    finally:
+        try:
+            gw.stop()
+        finally:
+            manager.shutdown()
+    return out
+
+
+def next_round(out_dir: pathlib.Path) -> int:
+    rounds = [
+        int(p.stem.split("_r")[-1])
+        for p in out_dir.glob("FLYWHEEL_r*.json")
+        if p.stem.split("_r")[-1].isdigit()
+    ]
+    return max(rounds, default=0) + 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sessions", type=int, default=1000, help="concurrent sticky sessions")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--workers", type=int, default=32, help="closed-loop driver threads")
+    ap.add_argument("--duration-s", type=float, default=30.0,
+                    help="serve duration of each leg BEFORE the flywheel turn")
+    ap.add_argument("--post-reload-s", type=float, default=15.0,
+                    help="how long to keep serving after the rolling reload")
+    ap.add_argument("--finetune-steps", type=int, default=10)
+    ap.add_argument("--max-version-lag", type=int, default=4)
+    ap.add_argument("--overhead-budget", type=float, default=0.10,
+                    help="max fractional act-p95 overhead capture may cost (rc gate)")
+    ap.add_argument("--out-dir", default=str(REPO_ROOT))
+    ap.add_argument("--work-dir", default="", help="run dir (default: a tempdir)")
+    ap.add_argument("--json", action="store_true", help="print the record as JSON only")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from sheeprl_tpu.config import Config, load_config_file
+    from sheeprl_tpu.flywheel.ingest import IngestLedger, ingest
+    from sheeprl_tpu.flywheel.recipe import run_flywheel, write_checkpoint
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+    from sheeprl_tpu.telemetry.schema import validate_event
+    from sheeprl_tpu.telemetry.sinks import JsonlSink
+
+    run_dir = pathlib.Path(args.work_dir) if args.work_dir else pathlib.Path(
+        tempfile.mkdtemp(prefix="bench_flywheel_")
+    )
+    ckpt_dir = run_dir / "checkpoint"
+    capture_root = run_dir / "capture"
+    seed_ckpt = write_checkpoint(ckpt_dir, 0, {"params": {"w": np.zeros((1,), np.float32)}})
+    sink = JsonlSink(str(run_dir / "telemetry.jsonl"))
+
+    def base_cfg(capture: bool) -> Any:
+        cfg = Config({"gateway": load_config_file(
+            REPO_ROOT / "sheeprl_tpu" / "configs" / "gateway" / "default.yaml").to_dict()})
+        cfg.set_path("gateway.replicas", args.replicas)
+        cfg.set_path("gateway.http.port", 0)
+        cfg.set_path("gateway.replica.max_sessions", max(4096, args.sessions))
+        cfg.set_path("gateway.replica.ckpt_dir", str(ckpt_dir))
+        # reloads happen ONLY through the gateway's rolling-reload path (the
+        # forced /admin/reload poll): a huge self-poll interval keeps the
+        # replicas from racing the measurement with their own polls
+        cfg.set_path("gateway.replica.hot_reload.poll_interval_s", 3600.0)
+        cfg.set_path("serve.capture.enabled", bool(capture))
+        cfg.set_path("serve.capture.dir", str(capture_root))
+        cfg.set_path("serve.capture.sample_frac", 1.0)
+        return cfg
+
+    # -- leg 1: capture OFF (the overhead denominator) ------------------------
+    print(f"[bench_flywheel] leg 1/2: {args.replicas} replicas, capture OFF, "
+          f"{args.sessions} sessions x {args.workers} workers for {args.duration_s:.0f}s",
+          flush=True)
+    baseline = run_serving_leg(
+        base_cfg(capture=False), args.sessions, args.workers, args.duration_s,
+        telemetry_dir=run_dir, sink=sink,
+    )
+    print(f"[bench_flywheel] baseline p95 {baseline['p95_ms']}ms "
+          f"({baseline['acked']} acked, {baseline['mismatches']} mismatches)", flush=True)
+
+    # -- leg 2: capture ON, then the flywheel turn mid-run --------------------
+    flywheel_out: Dict[str, Any] = {}
+
+    def flywheel_turn(gw: Any, stats: ActStats, stop: threading.Event) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        cfg = Config({"flywheel": load_config_file(
+            REPO_ROOT / "sheeprl_tpu" / "configs" / "flywheel" / "default.yaml").to_dict()})
+        cfg.set_path("flywheel.steps", args.finetune_steps)
+        cfg.set_path("flywheel.max_version_lag", args.max_version_lag)
+        cfg.set_path("flywheel.capture_dir", str(capture_root))
+        # the capture-overhead numerator: act p95 over the serving window
+        # BEFORE the turn — the same duration and load shape as the
+        # baseline leg. Latencies recorded during the turn itself (ingest +
+        # gradient burst + reload competing for the host) are the TURN's
+        # cost, not capture's, and must not pollute the overhead gate.
+        out["pre_turn_p95_ms"] = round(stats.percentile(0.95), 3)
+        t_turn = time.monotonic()
+        t_mark: Dict[str, float] = {}
+
+        def do_reload() -> Any:
+            # stamp the trigger instant: the reload-lag metric starts HERE,
+            # not when the whole recipe returns
+            t_mark["t"] = time.monotonic()
+            return gw.manager.rolling_reload()
+
+        summary = run_flywheel(
+            run_dir, seed_ckpt, cfg=cfg, rolling_reload=do_reload, emit=sink.write,
+        )
+        out["flywheel"] = summary
+        # reload-to-first-improved-act: the drivers keep hammering; scan for
+        # the first ack the BUMPED params_version served after the trigger
+        t_reload = t_mark.get("t", time.monotonic())
+        lag = -1.0
+        deadline = time.monotonic() + max(5.0, args.post_reload_s)
+        while time.monotonic() < deadline:
+            lag = stats.first_ack_at_version(t_reload, 1)
+            if lag >= 0:
+                break
+            time.sleep(0.05)
+        out["reload_to_fresh_act_s"] = round(lag, 3)
+        out["turn_s"] = round(time.monotonic() - t_turn, 3)
+        # keep serving past the swap so continuity across the reload is
+        # actually exercised (not just the first fresh ack)
+        t_hold = time.monotonic()
+        while time.monotonic() - t_hold < args.post_reload_s:
+            time.sleep(0.1)
+        return out
+
+    print(f"[bench_flywheel] leg 2/2: capture ON, flywheel turn mid-run", flush=True)
+    captured_leg = run_serving_leg(
+        base_cfg(capture=True), args.sessions, args.workers, args.duration_s,
+        telemetry_dir=run_dir, sink=sink, after_started=flywheel_turn,
+    )
+    flywheel_out = captured_leg.get("flywheel") or {}
+    ing = flywheel_out.get("ingest") or {}
+    print(f"[bench_flywheel] capture p95 {captured_leg['p95_ms']}ms; ingest "
+          f"{ing.get('samples', 0)} samples @ {ing.get('samples_per_s', 0)}/s; "
+          f"reload->fresh act {captured_leg.get('reload_to_fresh_act_s')}s; "
+          f"mismatches {captured_leg['mismatches']}", flush=True)
+
+    # -- exactly-once proof, now that serving (and capture) stopped: one pass
+    # absorbs the post-turn capture tail, the NEXT pass over the very same
+    # segments must ingest nothing and count everything as a duplicate
+    rb = ReplayBuffer(200_000, n_envs=1)
+    ledger = IngestLedger(capture_root / "ingest_ledger.json")
+    ingest(capture_root, rb, ledger=ledger)
+    reingest = ingest(capture_root, rb, ledger=IngestLedger(capture_root / "ingest_ledger.json"))
+    sink.close()
+
+    baseline_p95 = float(baseline["p95_ms"]) or 1e-9
+    # the pre-turn p95 is the capture leg's like-for-like number (same
+    # duration, same load, no flywheel turn competing for the host); the
+    # whole-leg p95 still lands in the record for context
+    capture_p95 = float(captured_leg.get("pre_turn_p95_ms") or captured_leg["p95_ms"])
+    overhead = (capture_p95 - baseline_p95) / baseline_p95
+    acked_loss = int(baseline["mismatches"]) + int(captured_leg["mismatches"])
+    reload_lag = float(captured_leg.get("reload_to_fresh_act_s", -1.0))
+    samples_per_s = float(ing.get("samples_per_s") or 0.0)
+    unit = f"flywheel ingest samples/sec ({args.sessions} sessions x {args.replicas} replicas)"
+
+    record: Dict[str, Any] = {
+        "event": "flywheel_bench",
+        "metric": (
+            f"data flywheel e2e: serve {args.sessions} sessions -> capture -> ingest -> "
+            f"fine-tune {args.finetune_steps} steps -> rolling reload -> serve again"
+        ),
+        "value": round(samples_per_s, 1),
+        "unit": unit,
+        "direction": "higher",
+        "vs_baseline": 1.0,
+        "ingest_samples_per_s": round(samples_per_s, 1),
+        "capture_act_p95_ms": round(capture_p95, 3),
+        "baseline_act_p95_ms": round(baseline_p95, 3),
+        "capture_overhead_frac": round(overhead, 4),
+        "reload_to_fresh_act_s": reload_lag,
+        "trace_join_frac": float(ing.get("trace_join_frac") or 0.0),
+        "acked_loss": acked_loss,
+        "ingested": int(ing.get("samples") or 0),
+        "duplicates": int(reingest.get("duplicates") or 0),
+        "torn_lines": int(ing.get("torn_lines") or 0),
+        "dropped_stale": int(ing.get("dropped_stale") or 0),
+        "finetune_steps": args.finetune_steps,
+        "params_version_served": 1 if reload_lag >= 0 else 0,
+        "sessions": args.sessions,
+        "replicas": args.replicas,
+        "requests": int(baseline["requests"]) + int(captured_leg["requests"]),
+        "acked": int(baseline["acked"]) + int(captured_leg["acked"]),
+        "duration_s": round(float(baseline["duration_s"]) + float(captured_leg["duration_s"]), 1),
+        "platform": "cpu",
+    }
+    problems = validate_event(record)
+    if problems:
+        print(f"[bench_flywheel] SCHEMA-INVALID record: {problems}", file=sys.stderr)
+    failures: List[str] = []
+    if acked_loss:
+        failures.append(f"acked_loss={acked_loss} (zero-loss-across-reload invariant)")
+    if overhead > args.overhead_budget:
+        failures.append(
+            f"capture overhead {overhead:.1%} exceeds the {args.overhead_budget:.0%} budget"
+        )
+    if record["ingested"] <= 0:
+        failures.append("nothing ingested")
+    elif record["trace_join_frac"] < 1.0:
+        failures.append(f"trace_join_frac={record['trace_join_frac']} (< 1.0)")
+    if reload_lag < 0:
+        failures.append("rolling reload never served the bumped params_version")
+    if int(reingest.get("samples") or 0) != 0:
+        failures.append(f"re-ingest was not a no-op ({reingest.get('samples')} samples)")
+
+    out_dir = pathlib.Path(args.out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    round_n = next_round(out_dir)
+    wrapper = {
+        "n": round_n,
+        "cmd": "python scripts/bench_flywheel.py " + " ".join(argv or sys.argv[1:]),
+        "rc": 0 if not problems and not failures else 1,
+        "failures": failures,
+        "parsed": record,
+    }
+    out_path = out_dir / f"FLYWHEEL_r{round_n:02d}.json"
+    out_path.write_text(json.dumps(wrapper, indent=1) + "\n")
+    if args.json:
+        print(json.dumps(record, indent=1))
+    else:
+        print(
+            f"[bench_flywheel] {out_path.name}: ingest {record['value']}/s "
+            f"({record['ingested']} samples, join {record['trace_join_frac']:.0%}), "
+            f"act p95 {record['baseline_act_p95_ms']}ms -> {record['capture_act_p95_ms']}ms "
+            f"(+{record['capture_overhead_frac']:.1%}), reload->fresh "
+            f"{record['reload_to_fresh_act_s']}s, acked_loss {record['acked_loss']}"
+            + (f" | FAILURES: {failures}" if failures else ""),
+            flush=True,
+        )
+    return wrapper["rc"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,10 +61,10 @@ def _has_timeout_arg(call: ast.Call) -> bool:
 
 
 class SocketTimeoutRule(Rule):
-    """blocking socket recv/accept/connect/makefile without a timeout (fleet/gateway/serve)."""
+    """blocking socket recv/accept/connect/makefile without a timeout (fleet/gateway/serve/flywheel)."""
 
     rule_id = "socket-timeout"
-    path_parts = ("fleet", "gateway", "serve")
+    path_parts = ("fleet", "gateway", "serve", "flywheel")
 
     def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
         # module-wide default timeout: everything is timed

@@ -198,10 +198,10 @@ class _AccessCollector(ast.NodeVisitor):
 
 
 class ThreadSharedStateRule(Rule):
-    """self.* written from >1 thread root without a declared lock (engine/fleet/gateway/serve)."""
+    """self.* written from >1 thread root without a declared lock (engine/fleet/gateway/serve/flywheel)."""
 
     rule_id = "thread-shared-state"
-    path_parts = ("engine", "fleet", "gateway", "serve")
+    path_parts = ("engine", "fleet", "gateway", "serve", "flywheel")
 
     def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):

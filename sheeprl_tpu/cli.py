@@ -313,6 +313,39 @@ def brokerd(args: Optional[Sequence[str]] = None) -> None:
     run_brokerd_from_cfg(cfg)
 
 
+def flywheel(args: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl_tpu flywheel run_dir=<serving run dir>
+    checkpoint_path=<served ckpt> [flywheel.steps=100 ...]` — one turn of
+    the data flywheel (flywheel/recipe.py): ingest the run's serve-side
+    capture segments into a replay buffer (exactly-once, torn-tail
+    tolerant, staleness-gated by `flywheel.max_version_lag`), fine-tune
+    `flywheel.steps` gradient steps on the mixed buffer, checkpoint the
+    result beside the served checkpoint and push it through the gateway's
+    rolling reload (`flywheel.gateway_url`, or the replicas' own hot-reload
+    polls). See howto/data_flywheel.md."""
+    argv = list(args if args is not None else sys.argv[1:])
+    from .config.compose import CONFIG_ROOT
+
+    run_dir: Optional[str] = None
+    rest: List[str] = []
+    for a in argv:
+        if a.startswith("run_dir="):
+            run_dir = a.split("=", 1)[1]
+        else:
+            rest.append(a)
+    if run_dir is None:
+        raise ValueError("flywheel requires `run_dir=<serving run dir>`")
+    ckpt_path, rest = _split_checkpoint_arg(rest, "flywheel")
+    cfg = Config(
+        {"flywheel": load_config_file(CONFIG_ROOT / "flywheel" / "default.yaml").to_dict()}
+    )
+    _apply_cli_overrides(cfg, rest)
+    from .flywheel.recipe import run_flywheel
+
+    summary = run_flywheel(run_dir, ckpt_path, cfg=cfg)
+    print(f"[flywheel] {summary}", flush=True)
+
+
 def resume(args: Optional[Sequence[str]] = None) -> None:
     """`sheeprl_tpu resume run_dir=<logs/runs/.../version_N> [key=value ...]`
     — relaunch a preempted/crashed run from its newest complete checkpoint
@@ -441,11 +474,11 @@ def available_agents() -> None:
 
 
 def main() -> None:
-    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|gateway|brokerd|doctor|trace|lint|registration|agents> ...`"""
+    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|gateway|brokerd|flywheel|doctor|trace|lint|registration|agents> ...`"""
     argv = sys.argv[1:]
     if argv and argv[0] in (
-        "run", "eval", "evaluation", "resume", "serve", "gateway", "brokerd", "doctor",
-        "trace", "lint", "registration", "agents",
+        "run", "eval", "evaluation", "resume", "serve", "gateway", "brokerd", "flywheel",
+        "doctor", "trace", "lint", "registration", "agents",
     ):
         cmd, rest = argv[0], argv[1:]
     else:
@@ -462,6 +495,8 @@ def main() -> None:
         gateway(rest)
     elif cmd == "brokerd":
         brokerd(rest)
+    elif cmd == "flywheel":
+        flywheel(rest)
     elif cmd == "doctor":
         doctor(rest)
     elif cmd == "trace":

@@ -174,17 +174,19 @@ def _bench_report(bench_dir: Path, cfg: Any) -> Optional[Dict[str, Any]]:
         records = mod.load_trajectory(bench_dir)
         multichip = mod.load_multichip(bench_dir)
         serve = mod.load_serve_trajectory(bench_dir)
+        flywheel = mod.load_flywheel_trajectory(bench_dir)
     except Exception as err:
         # a half-written/corrupt artifact must not cost the user the whole
         # run diagnosis — report it as a failed gate instead of a traceback
         return {"ok": False, "failures": [f"bench artifacts unreadable: {err}"]}
-    if not records and not multichip and not serve:
+    if not records and not multichip and not serve and not flywheel:
         return {"ok": True, "note": f"no BENCH_*.json under {bench_dir}"}
     return mod.compare(
         records,
         threshold=float(threshold) if threshold is not None else 0.2,
         multichip=multichip,
         serve=serve,
+        flywheel=flywheel,
     )
 
 

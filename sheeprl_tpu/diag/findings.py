@@ -776,6 +776,52 @@ def detect_cross_process_stall(tl: Timeline, cfg: Any = None) -> List[Finding]:
     ]
 
 
+def detect_flywheel_staleness(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """The data flywheel is falling behind: ingest passes whose FRESHEST
+    sample lags the serving ``params_version`` by at least
+    ``diag.flywheel.max_lag`` versions. Experience gathered that many
+    policies ago is training the next policy — the loop's latency has grown
+    past the staleness the fine-tune recipe was budgeted for (and past
+    ``flywheel.max_version_lag`` the samples start being dropped outright)."""
+    max_lag = int(_sel(cfg, "diag.flywheel.max_lag", 3))
+    ingests = [rec for rec in tl.of("flywheel") if rec.get("action") == "ingest"]
+    laggy = [rec for rec in ingests if int(rec.get("version_lag") or 0) >= max_lag]
+    if not laggy:
+        return []
+    worst = max(int(rec.get("version_lag") or 0) for rec in laggy)
+    dropped = sum(int(rec.get("dropped_stale") or 0) for rec in ingests)
+    return [
+        Finding(
+            code="flywheel_staleness",
+            severity="warning",
+            title=(
+                f"flywheel staleness: ingested samples lag the serving "
+                f"params_version by up to {worst} version(s) (>= {max_lag})"
+            ),
+            detail=(
+                f"{len(laggy)}/{len(ingests)} ingest pass(es) over the lag threshold; "
+                f"{dropped} sample(s) dropped by the recipe's max_version_lag gate. "
+                "The policy being fine-tuned is learning from experience produced "
+                "that many reloads ago."
+            ),
+            remediation=(
+                "Run `sheeprl_tpu flywheel` more often (or continuously) so capture "
+                "backlogs don't span multiple reloads; check that capture is enabled "
+                "on every replica (`serve.capture.enabled`) and that ingestion isn't "
+                "skipping segments (torn_lines in the ingest summary). Raising "
+                "`flywheel.max_version_lag` admits staler samples instead of "
+                "dropping them — a trade, not a fix."
+            ),
+            data={
+                "worst_lag": worst,
+                "laggy_ingests": len(laggy),
+                "ingests": len(ingests),
+                "dropped_stale": dropped,
+            },
+        )
+    ]
+
+
 def detect_incomplete_stream(tl: Timeline, cfg: Any = None) -> List[Finding]:
     """No shutdown event: the process died without closing telemetry — a
     crash, OOM-kill or external SIGKILL (a clean preemption still writes
@@ -822,6 +868,7 @@ DETECTORS: List[Callable[[Timeline, Any], List[Finding]]] = [
     detect_broker_lag,
     detect_gateway_shedding,
     detect_cross_process_stall,
+    detect_flywheel_staleness,
     detect_incomplete_stream,
 ]
 

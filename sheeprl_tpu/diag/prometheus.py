@@ -396,6 +396,29 @@ class Registry:
                 self.counter(
                     f"broker_{action or 'event'}_total", "session-broker lifecycle events"
                 ).inc()
+        elif event == "flywheel":
+            # data-flywheel lifecycle (sheeprl_tpu/flywheel/): the action
+            # vocabulary is a closed set (literal at every emit site), so
+            # the sheeprl_flywheel_* counter family stays bounded; ingest
+            # passes additionally mirror their headline numbers as gauges
+            action = rec.get("action")
+            self.counter(
+                f"flywheel_{action or 'event'}_total", "data-flywheel lifecycle events"
+            ).inc()
+            if action == "ingest":
+                self.gauge(
+                    "flywheel_ingest_samples", "samples ingested by the last pass"
+                ).set(float(rec.get("samples") or 0))
+                self.gauge(
+                    "flywheel_ingest_samples_per_s", "ingest throughput of the last pass"
+                ).set(float(rec.get("samples_per_s") or 0.0))
+                self.gauge(
+                    "flywheel_version_lag",
+                    "serving params_version minus the freshest ingested sample's",
+                ).set(float(rec.get("version_lag") or 0))
+                self.gauge(
+                    "flywheel_dropped_stale", "samples dropped by the staleness gate"
+                ).set(float(rec.get("dropped_stale") or 0))
         elif event == "chaos":
             self.counter(
                 f"chaos_{rec.get('fault', 'fault')}_total", "injected chaos faults"

@@ -84,7 +84,7 @@ def discover_streams(log_dir: Any) -> List[Tuple[str, Path]]:
         if base.is_dir():
             for sub in sorted(base.iterdir()):
                 add(sub.name, sub / "telemetry.jsonl")
-    for extra in ("gateway", "serve"):
+    for extra in ("gateway", "serve", "flywheel"):
         add(extra, log_dir / extra / "telemetry.jsonl")
     return out
 

@@ -111,6 +111,38 @@ def build_cluster(
         )
     else:
         spec_base["mode"] = "synthetic"
+        # synthetic fleets become hot-reloadable (the flywheel loop) when a
+        # checkpoint dir is named: replicas seed from its newest ckpt and
+        # watch it exactly like checkpoint replicas watch theirs
+        ckpt_dir = sel("gateway.replica.ckpt_dir", None)
+        if ckpt_dir:
+            spec_base["ckpt_dir"] = str(ckpt_dir)
+            spec_base["hot_reload"] = {
+                "enabled": bool(sel("gateway.replica.hot_reload.enabled", True)),
+                "poll_interval_s": float(sel("gateway.replica.hot_reload.poll_interval_s", 2.0)),
+            }
+    # serve-side trajectory capture (sheeprl_tpu/flywheel/): the flywheel's
+    # intake rides into every replica spec; each replica writes its own
+    # <dir>/replica_NNN/capture.jsonl segments
+    if bool(sel("serve.capture.enabled", False)):
+        capture_dir = sel("serve.capture.dir", None) or (
+            str(pathlib.Path(str(telemetry_dir)) / "capture") if telemetry_dir else None
+        )
+        if not capture_dir:
+            # capture silently writing nowhere would surface weeks later as
+            # "no fresh capture samples" — refuse loudly instead
+            raise ValueError(
+                "serve.capture.enabled=True but no capture directory resolves: "
+                "set serve.capture.dir, or enable gateway.telemetry.jsonl so "
+                "<run_dir>/capture is available as the default"
+            )
+        spec_base["capture"] = {
+            "enabled": True,
+            "dir": str(capture_dir),
+            "sample_frac": float(sel("serve.capture.sample_frac", 1.0)),
+            "max_bytes": int(sel("serve.capture.max_bytes", 64 * 1024 * 1024)),
+            "log_every_s": float(sel("serve.capture.log_every_s", 10.0)),
+        }
     chaos = sel("gateway.replica.chaos")
     if chaos:
         spec_base["chaos"] = chaos.to_dict() if hasattr(chaos, "to_dict") else dict(chaos)

@@ -28,21 +28,26 @@ Routing rules:
   Retry-After) before any replica sees the request; deterministic-eval
   traffic can be marked/classified low-priority and is shed first.
 
-Known limitation: a forward that times out (``forward_timeout_s``) is
-treated as not-executed and replayed from the last acked state. For a
-session's very FIRST request there is no acked state yet, so if the
-replica actually completed the step before the timeout, the replay runs
-stateless and the hidden step is not healed. Closing this fully needs
-replica-side request idempotency keys; in practice the replica's own
-``request_timeout_s`` abandons queued work on the same deadline, so the
-window requires a single policy step to outlast the forward timeout.
-The external-broker in-doubt-put protection shares the same first-request
-edge: an abandoned broker put is healed by marking the pin suspect and
-rehydrating at the last ACKED version, but a session whose FIRST ack never
-happened has no pin to mark (and a suspect pin can be LRU-evicted) — if
-that one in-doubt put actually landed, the retry rehydrates the broker's
-newest (unacked) state. Both windows need the same replica-side
-idempotency keys to close completely.
+Replica-side idempotency (the documented first-request in-doubt window,
+now narrowed to a race): every session request gets ONE ``request_id``,
+reused verbatim across the gateway's forward retries. A replica remembers
+the last ``(request_id, response)`` per session, so a retried forward whose
+first attempt COMPLETED — the step ran but the response was lost to a
+timeout or a dropped connection — is answered from the replay cache instead
+of stepping the session a second time. This is exactly the case the
+acked-state replay could not heal for a session's very FIRST request (no
+acked state exists yet to replay from), and the same shield covers the
+external-broker first-request in-doubt put: the retried forward replays the
+ORIGINAL response body, so the gateway puts (idempotently, by client_seq)
+and acks the same post-step state the hidden execution produced. The replay
+cache is checked BEFORE any inbound state import — importing the pre-step
+rehydration blob and then replaying the post-step body would rewind the
+replica's cache out from under the acked trajectory. Residual window: the
+cache is populated at COMPLETION, so a retry that arrives while the first
+attempt is still mid-step misses it and the session steps twice — that now
+requires a single policy step to outlast ``forward_timeout_s`` AND the
+retry to land before it finishes, strictly narrower than before (any
+post-timeout completion used to be unhealable).
 
 Endpoints mirror the single-replica PolicyServer so clients cannot tell the
 difference: ``POST /v1/act``, ``GET /healthz`` (fleet view), ``GET /stats``
@@ -54,6 +59,7 @@ import json
 import random
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..serve.batcher import jittered_retry_after
@@ -481,6 +487,14 @@ class Gateway:
     ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
         sid = payload.get("session_id")
         sid = str(sid) if sid is not None else None
+        # replica-side idempotency key: ONE id per client request, reused
+        # verbatim across every forward retry. A retried forward whose first
+        # attempt actually executed (the response was lost to a timeout or a
+        # dropped connection) is answered from the replica's replay cache
+        # instead of stepping the session a second time — this closes the
+        # first-request in-doubt window the failover replay alone could not
+        # (no acked state exists yet to replay from).
+        request_id = uuid.uuid4().hex if sid is not None else None
         force_state = False
         last_err: Optional[str] = None
         for attempt in range(self.max_attempts):
@@ -502,6 +516,11 @@ class Gateway:
                 "obs": payload.get("obs"),
                 "deterministic": bool(payload.get("deterministic", False)),
             }
+            # flywheel capture passthrough: client-reported reward/done for
+            # the session's previous step ride to the replica's capture hook
+            for extra in ("reward", "done"):
+                if extra in payload:
+                    body[extra] = payload[extra]
             if trace is not None:
                 # the replica hop continues THIS trace: its stage spans land
                 # on the replica's own stream with the same trace_id
@@ -510,6 +529,7 @@ class Gateway:
                 )
             if sid is not None:
                 body["session_id"] = sid
+                body["request_id"] = request_id
                 body["return_state"] = True
                 if needs_state or force_state:
                     try:

@@ -125,12 +125,44 @@ def _build_replica_server(spec: Dict[str, Any]) -> Any:
                 loaded_step=loaded_step,
             )
     elif mode == "synthetic":
+        # with a ckpt_dir the synthetic fleet is hot-reloadable exactly like
+        # the checkpoint fleet: the newest ckpt_<N>.ckpt seeds the params
+        # (so a respawned replica serves the latest fine-tune, not version
+        # 0) and a CheckpointReloader watches the dir — what lets the data
+        # flywheel's rolling reload be proven without a training run
+        params = {"w": np.zeros((1,), np.float32)}
+        loaded_step = -1
+        ckpt_dir = spec.get("ckpt_dir")
+        if ckpt_dir:
+            import pathlib
+
+            from ..serve.reload import _list_checkpoints
+            from ..utils.checkpoint import CheckpointManager
+
+            ckpts = _list_checkpoints(pathlib.Path(ckpt_dir))
+            if ckpts:
+                loaded_step, newest = ckpts[-1]
+                try:
+                    params = CheckpointManager.load_for_inference(newest)["params"]
+                except Exception:
+                    loaded_step = -1  # torn seed file: serve the zero params
         policy = InferencePolicy(
             synthetic_counter_core(),
-            {"w": np.zeros((1,), np.float32)},
+            params,
             buckets=spec.get("buckets") or [1, 2, 4, 8, 16],
         )
         policy.warmup()
+        hot = spec.get("hot_reload") or {}
+        if ckpt_dir and bool(hot.get("enabled", True)):
+            from ..serve.reload import CheckpointReloader
+
+            reloader = CheckpointReloader(
+                policy,
+                ckpt_dir,
+                poll_interval_s=float(hot.get("poll_interval_s", 2.0)),
+                loaded_step=loaded_step,
+                sink=sink,
+            )
     else:
         raise ValueError(f"unknown replica mode '{mode}' (checkpoint | synthetic)")
     if spec.get("max_sessions"):
@@ -166,6 +198,17 @@ def _build_replica_server(spec: Dict[str, Any]) -> Any:
             if chaos is not None:
                 chaos.on_step(n)  # may os._exit — a hard mid-stream death
 
+    capture = None
+    if spec.get("capture"):
+        from ..flywheel.capture import capture_writer_from_spec
+
+        capture = capture_writer_from_spec(
+            spec["capture"],
+            replica_id=int(spec.get("replica_id", 0)),
+            incarnation=int(spec.get("incarnation", 0)),
+            telem_sink=sink,
+        )
+
     return PolicyServer(
         policy,
         batcher,
@@ -175,6 +218,8 @@ def _build_replica_server(spec: Dict[str, Any]) -> Any:
         on_act=on_act,
         sink=sink,
         replica_id=int(spec.get("replica_id", 0)),
+        capture=capture,
+        idempotency_sessions=int(spec.get("max_sessions") or 4096),
     )
 
 

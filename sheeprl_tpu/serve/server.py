@@ -69,7 +69,21 @@ class PolicyServer:
 
     ``on_act`` is an optional hook invoked at the top of every HTTP act
     request (after parsing, before batching) — the gateway's replica wrapper
-    uses it for chaos injection and synthetic latency."""
+    uses it for chaos injection and synthetic latency.
+
+    ``capture`` is an optional flywheel
+    :class:`~sheeprl_tpu.flywheel.capture.CaptureWriter`: every acked HTTP
+    act of a sampled session is appended to the replica's capture segments
+    (the data-flywheel intake — howto/data_flywheel.md).
+
+    Idempotency: a session request carrying a ``request_id`` is answered
+    from a per-session replay cache when the SAME id arrives again — the
+    gateway stamps one id per client request and reuses it across its
+    forward retries, so a retried forward whose first attempt actually
+    executed (response lost to a timeout) returns the original response
+    instead of stepping the session twice. One cached entry per session
+    (the failover protocol only ever retries the latest request),
+    LRU-bounded like every other per-session map."""
 
     def __init__(
         self,
@@ -82,6 +96,8 @@ class PolicyServer:
         on_act: Optional[Any] = None,
         sink: Any = None,
         replica_id: int = 0,
+        capture: Any = None,
+        idempotency_sessions: int = 4096,
     ) -> None:
         self.policy = policy
         self.batcher = batcher
@@ -94,6 +110,14 @@ class PolicyServer:
         # answers, profiler markers); None = tracing surfaces disabled
         self.sink = sink
         self.replica_id = int(replica_id)
+        self.capture = capture
+        from collections import OrderedDict
+
+        self._idem_lock = threading.Lock()
+        self._idem_max = int(idempotency_sessions)
+        # sid -> (request_id, cached 200 body): the duplicate-forward shield
+        self._idem: "OrderedDict[str, tuple]" = OrderedDict()
+        self.idempotent_replays = 0
         from ..telemetry.tracing import RemoteProfiler
 
         profile_root = (
@@ -129,6 +153,25 @@ class PolicyServer:
 
     def stats(self) -> Dict[str, Any]:
         return self.batcher.serve_record()
+
+    # -- request idempotency (the gateway's duplicate-forward shield) --------
+    def idempotent_response(self, sid: str, request_id: str) -> Optional[Dict[str, Any]]:
+        """The cached 200 body when this (session, request_id) was already
+        served — the retried forward must NOT re-step the session."""
+        with self._idem_lock:
+            entry = self._idem.get(sid)
+            if entry is not None and entry[0] == request_id:
+                self._idem.move_to_end(sid)
+                self.idempotent_replays += 1
+                return entry[1]
+        return None
+
+    def remember_response(self, sid: str, request_id: str, body: Dict[str, Any]) -> None:
+        with self._idem_lock:
+            self._idem[sid] = (request_id, body)
+            self._idem.move_to_end(sid)
+            while len(self._idem) > self._idem_max:
+                self._idem.popitem(last=False)
 
     def _emit_act_spans(self, ctx: Any, timing: Dict[str, Any], session: Optional[str]) -> None:
         """Write the request's stage spans (batch_queue → jit_step →
@@ -182,6 +225,17 @@ class PolicyServer:
             float(self.policy.retraces_since_warmup())
         )
         registry.gauge("sessions", "live recurrent sessions").set(float(len(self.policy.sessions)))
+        registry.gauge("idempotent_replays", "duplicate forwards answered from cache").set(
+            float(self.idempotent_replays)
+        )
+        if self.capture is not None:
+            snap = self.capture.snapshot()
+            registry.gauge("capture_captured", "flywheel capture records written").set(
+                float(snap["captured"])
+            )
+            registry.gauge("capture_skipped", "acts skipped by capture sampling").set(
+                float(snap["skipped"])
+            )
         return registry.render()
 
     @property
@@ -225,6 +279,11 @@ class PolicyServer:
             self.reloader.stop()
         self.profiler.stop()  # close a live on-demand capture window
         self.batcher.stop()
+        if self.capture is not None:
+            try:
+                self.capture.close()
+            except Exception:
+                pass
 
 
 def _make_handler(server: "PolicyServer"):
@@ -298,6 +357,18 @@ def _make_handler(server: "PolicyServer"):
                 obs = {k: np.asarray(v) for k, v in raw_obs.items()}
                 deterministic = bool(payload.get("deterministic", False))
                 session = payload.get("session_id")
+                request_id = payload.get("request_id")
+                # idempotent replay — checked BEFORE the state import: a
+                # retried forward whose first attempt executed must return
+                # the ORIGINAL response untouched. Importing the (acked,
+                # pre-step) state first would rewind the cached latent while
+                # the replayed body still carries the post-step blob — the
+                # cache and the acked trajectory would diverge.
+                if session is not None and request_id is not None:
+                    cached = server.idempotent_response(str(session), str(request_id))
+                    if cached is not None:
+                        self._reply(200, cached)
+                        return
                 # externalized-state protocol (gateway broker): an inbound
                 # blob re-hydrates the replica's session cache BEFORE the
                 # step — the broker's copy wins over whatever is cached
@@ -375,6 +446,24 @@ def _make_handler(server: "PolicyServer"):
                         410, {"error": "session_expired", "session_id": session}
                     )
                     return
+            if session is not None and request_id is not None:
+                # the duplicate-forward shield: a retried forward with the
+                # same request_id replays THIS body instead of re-stepping
+                server.remember_response(str(session), str(request_id), dict(body))
+            if server.capture is not None:
+                # flywheel intake: the acked step becomes a training sample
+                # (per-session sampling + schema'd JSONL happen inside the
+                # writer; failures are counted there, never surfaced here)
+                server.capture.record(
+                    session,
+                    raw_obs,
+                    body["actions"],
+                    server.policy.params_version,
+                    trace_id=ctx.trace_id if ctx is not None else None,
+                    deterministic=deterministic,
+                    reward=payload.get("reward"),
+                    done=payload.get("done"),
+                )
             self._reply(200, body)
 
         def _admin_reload(self) -> None:
@@ -484,6 +573,22 @@ def serve_from_checkpoint(ckpt_path: Any, cfg: Any, block: bool = True) -> Polic
             loaded_step=loaded_step,
             sink=sink,
         )
+    capture = None
+    if bool(sel("serve.capture.enabled", False)):
+        from ..flywheel.capture import capture_writer_from_spec
+
+        run_dir = ckpt_path.parent.parent
+        capture = capture_writer_from_spec(
+            {
+                "enabled": True,
+                "dir": str(sel("serve.capture.dir", "") or (run_dir / "capture")),
+                "sample_frac": float(sel("serve.capture.sample_frac", 1.0)),
+                "max_bytes": int(sel("serve.capture.max_bytes", 64 * 1024 * 1024)),
+                "log_every_s": float(sel("serve.capture.log_every_s", 10.0)),
+            },
+            replica_id=0,
+            telem_sink=sink,
+        )
     server = PolicyServer(
         policy,
         batcher,
@@ -492,6 +597,7 @@ def serve_from_checkpoint(ckpt_path: Any, cfg: Any, block: bool = True) -> Polic
         port=int(sel("serve.http.port", 8190)),
         http_enabled=bool(sel("serve.http.enabled", True)),
         sink=sink,  # traced requests write their stage spans here too
+        capture=capture,
     )
     if sink is not None:
         sink.write(batcher.serve_record())  # startup snapshot (warmup state)

@@ -314,6 +314,65 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "fsync_p95_ms": (False, _NUM),
         "detail": (False, _STR),
     },
+    # one served step captured by the data flywheel (sheeprl_tpu/flywheel/
+    # capture.py): written to the replica's OWN capture segments
+    # (<capture_dir>/replica_NNN/capture.jsonl, JsonlSink rotation), NOT the
+    # telemetry stream — but it shares this schema so capture files are
+    # validated and torn-tail tolerant the same way. `step` is the
+    # per-session capture counter on this replica incarnation (the dedup
+    # axis ingest uses), `trace_id` the PR-10 join key back to the gateway
+    # request, `params_version` the policy version that produced the action
+    # (the staleness axis the fine-tune recipe filters on). `obs` is the
+    # raw numeric observation tree and `actions` the [1, ...] action row —
+    # numbers only, never free-form client fields (the PII boundary).
+    "capture": {
+        "session_id": (True, _STR),
+        "step": (True, _NUM),
+        "obs": (True, _DICT),
+        "actions": (True, list),
+        "params_version": (True, _NUM),
+        "trace_id": (False, _STR),
+        "replica": (False, _NUM),
+        "incarnation": (False, _NUM),
+        "deterministic": (False, bool),
+        "reward": (False, _NUM),
+        "done": (False, bool),
+        "t": (False, _NUM),
+    },
+    # data-flywheel lifecycle (sheeprl_tpu/flywheel/): `action` is
+    # capture_interval (periodic capture-writer snapshot on the replica's
+    # stream: captured/skipped/bytes), ingest (offline segment replay into
+    # the replay buffer: samples/duplicates/torn_lines + the
+    # params_version spread and its lag vs the serving version — what the
+    # doctor's flywheel_staleness finding reads), dropped_stale (samples
+    # the recipe refused for exceeding max_version_lag), finetune (one
+    # gradient burst), reload (the new checkpoint pushed through the
+    # gateway's rolling reload). Prometheus mirrors actions as
+    # `sheeprl_flywheel_<action>_total` plus ingest gauges.
+    "flywheel": {
+        "action": (True, _STR),
+        "samples": (False, _NUM),
+        "duplicates": (False, _NUM),
+        "torn_lines": (False, _NUM),
+        "segments": (False, _NUM),
+        "captured": (False, _NUM),
+        "skipped": (False, _NUM),
+        "bytes": (False, _NUM),
+        "dropped_stale": (False, _NUM),
+        "samples_per_s": (False, _NUM),
+        "unrewarded_tails": (False, _NUM),
+        "version_min": (False, _NUM),
+        "version_max": (False, _NUM),
+        "serving_version": (False, _NUM),
+        "version_lag": (False, _NUM),
+        "steps": (False, _NUM),
+        "step": (False, _NUM),
+        "params_version": (False, _NUM),
+        "replica": (False, _NUM),
+        "loss": (False, _NUM),
+        "detail": (False, _STR),
+        "t": (False, _NUM),
+    },
     # deterministic fault injection (resilience/chaos.py): faults the
     # SUPERVISOR injects (worker-side faults surface as `fleet` incidents —
     # a chaos crash is indistinguishable from a real one by design)
@@ -421,6 +480,43 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "broker": (False, _DICT),
         "broker_recovery_s": (False, _NUM),
         "broker_repl_lag_p95_ms": (False, _NUM),
+    },
+    # data-flywheel end-to-end bench record (scripts/bench_flywheel.py ->
+    # FLYWHEEL_r*.json): one full serve -> capture -> ingest -> fine-tune ->
+    # rolling-reload -> serve-again round. The headline `value` is ingest
+    # samples/sec (direction: higher); `capture_act_p95_ms` is the act p95
+    # WITH capture enabled and `capture_overhead_frac` its fractional cost
+    # vs the capture-off baseline (both lower-is-better, gated by
+    # bench_compare.py); `reload_to_fresh_act_s` is the lag from the
+    # rolling-reload trigger to the first acked act served by the bumped
+    # params_version; `trace_join_frac` is the fraction of ingested samples
+    # that joined back to a capture trace id (must be 1.0); `acked_loss`
+    # counts counter-continuity mismatches across the reload (invariant 0).
+    "flywheel_bench": {
+        "metric": (True, _STR),
+        "value": (True, _NUM),
+        "unit": (True, _STR),
+        "vs_baseline": (True, _NUM),
+        "direction": (False, _STR),
+        "ingest_samples_per_s": (True, _NUM),
+        "capture_act_p95_ms": (True, _NUM),
+        "baseline_act_p95_ms": (True, _NUM),
+        "capture_overhead_frac": (True, _NUM),
+        "reload_to_fresh_act_s": (True, _NUM),
+        "trace_join_frac": (True, _NUM),
+        "acked_loss": (True, _NUM),
+        "ingested": (False, _NUM),
+        "duplicates": (False, _NUM),
+        "torn_lines": (False, _NUM),
+        "dropped_stale": (False, _NUM),
+        "finetune_steps": (False, _NUM),
+        "params_version_served": (False, _NUM),
+        "sessions": (False, _NUM),
+        "replicas": (False, _NUM),
+        "requests": (False, _NUM),
+        "acked": (False, _NUM),
+        "duration_s": (False, _NUM),
+        "platform": (False, _STR),
     },
 }
 

@@ -1,0 +1,646 @@
+"""Data-flywheel tests (sheeprl_tpu/flywheel/): serve-side capture rotation
+and per-session counters, exactly-once ingestion across re-runs and torn
+tails, the staleness gate, the fine-tune recipe, the bench_compare FLYWHEEL
+gate, the doctor ``flywheel_staleness`` finding — and the miniature
+end-to-end loop: synthetic counter-core sessions served through the real
+gateway → capture → ingest → one fine-tune burst → rolling reload, with
+exactly-once ingestion proven and a bumped ``params_version`` served after
+the reload without a single acked-request mismatch."""
+import importlib.util
+import json
+import pathlib
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.flywheel import (
+    CaptureWriter,
+    IngestLedger,
+    discover_capture_streams,
+    ingest,
+    run_flywheel,
+    session_sampled,
+    write_checkpoint,
+)
+from sheeprl_tpu.telemetry.schema import validate_event
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+spec = importlib.util.spec_from_file_location("bench_compare", REPO / "scripts" / "bench_compare.py")
+bench_compare = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench_compare)
+
+
+def _write_capture(
+    root: pathlib.Path,
+    sessions: int = 3,
+    steps: int = 10,
+    version: int = 0,
+    max_bytes: int = 0,
+    replica: int = 0,
+) -> CaptureWriter:
+    w = CaptureWriter(
+        str(root / f"replica_{replica:03d}" / "capture.jsonl"),
+        max_bytes=max_bytes,
+        replica_id=replica,
+    )
+    for i in range(steps):
+        for s in range(sessions):
+            assert w.record(
+                f"s{s}",
+                {"x": [[float(i)]]},
+                [[float(i)]],
+                params_version=version,
+                trace_id=f"tr-{s}-{i}",
+                reward=0.5,
+            )
+    w.close()
+    return w
+
+
+# -- capture ------------------------------------------------------------------
+
+
+def test_session_sampled_is_stable_and_respects_fraction():
+    assert session_sampled("any", 1.0) and not session_sampled("any", 0.0)
+    # stability: the same id answers the same on every call/process
+    assert all(session_sampled("abc", 0.5) == session_sampled("abc", 0.5) for _ in range(10))
+    hits = sum(session_sampled(f"s{i}", 0.25) for i in range(2000))
+    assert 300 < hits < 700  # ~25%, loose bounds
+
+
+def test_capture_writer_rotation_per_session_steps_and_schema(tmp_path):
+    w = _write_capture(tmp_path, sessions=2, steps=30, max_bytes=1500)
+    stream_dir = tmp_path / "replica_000"
+    segments = sorted(stream_dir.glob("capture.jsonl*"))
+    assert len(segments) > 2, "rotation never triggered"
+    # every line of every segment is schema-valid; per-session steps are
+    # contiguous 0..N-1 across the segment boundary
+    per_session: dict = {}
+    for seg in segments:
+        for line in seg.read_text().splitlines():
+            rec = json.loads(line)
+            assert validate_event(rec) == [], rec
+            if rec["event"] != "capture":
+                continue
+            per_session.setdefault(rec["session_id"], []).append(rec["step"])
+    for sid, steps in per_session.items():
+        assert sorted(steps) == list(range(30)), sid
+    assert w.snapshot()["captured"] == 60
+
+
+def test_capture_skips_sessionless_and_unsampled(tmp_path):
+    w = CaptureWriter(str(tmp_path / "capture.jsonl"), sample_frac=0.0)
+    assert not w.record(None, {"x": [[0.0]]}, [[0.0]], 0)
+    assert not w.record("sid", {"x": [[0.0]]}, [[0.0]], 0)
+    assert w.snapshot() == {"captured": 0, "skipped": 2, "errors": 0, "sessions": 0}
+    w.close()
+
+
+# -- ingestion ----------------------------------------------------------------
+
+
+def test_ingest_exactly_once_across_reruns(tmp_path):
+    _write_capture(tmp_path, sessions=3, steps=10)
+    rb = ReplayBuffer(1000, n_envs=1)
+    first = ingest(tmp_path, rb)
+    assert first["samples"] == 30 and first["duplicates"] == 0
+    assert first["trace_join_frac"] == 1.0
+    assert "rewards" in rb and "params_version" in rb
+    # a FRESH ledger instance reads the persisted file: re-runs are no-ops
+    rb2 = ReplayBuffer(1000, n_envs=1)
+    again = ingest(tmp_path, rb2, ledger=IngestLedger(tmp_path / "ingest_ledger.json"))
+    assert again["samples"] == 0 and again["duplicates"] == 30
+    assert rb2.empty
+    # NEW capture after the first pass ingests exactly the delta
+    w = CaptureWriter(str(tmp_path / "replica_000" / "capture.jsonl"))
+    for s in range(3):
+        # continue each session's counter where the first writer stopped
+        w._steps[f"s{s}"] = 10
+        assert w.record(f"s{s}", {"x": [[9.0]]}, [[9.0]], 1, trace_id=f"tr2-{s}")
+    w.close()
+    delta = ingest(tmp_path, rb, ledger=IngestLedger(tmp_path / "ingest_ledger.json"))
+    assert delta["samples"] == 3 and delta["duplicates"] == 30
+
+
+def test_ingest_tolerates_torn_tail_exactly_once(tmp_path):
+    """A capture file truncated mid-record (replica SIGKILLed mid-write)
+    ingests every complete prior sample exactly once; the torn line is
+    counted, not fatal, and a re-ingest over the same torn segment is a
+    no-op."""
+    _write_capture(tmp_path, sessions=2, steps=5)
+    live = tmp_path / "replica_000" / "capture.jsonl"
+    raw = live.read_bytes()
+    live.write_bytes(raw[: len(raw) - 17])  # tear the last record mid-JSON
+    rb = ReplayBuffer(1000, n_envs=1)
+    first = ingest(tmp_path, rb)
+    assert first["samples"] == 9  # 10 written, the torn last one dropped
+    assert first["torn_lines"] == 1
+    again = ingest(tmp_path, ReplayBuffer(10, n_envs=1),
+                   ledger=IngestLedger(tmp_path / "ingest_ledger.json"))
+    assert again["samples"] == 0 and again["duplicates"] == 9
+
+
+def test_ingest_staleness_gate_drops_and_ledgers(tmp_path):
+    _write_capture(tmp_path, sessions=1, steps=4, version=0)
+    w = CaptureWriter(str(tmp_path / "replica_001" / "capture.jsonl"), replica_id=1)
+    for i in range(4):
+        assert w.record("fresh", {"x": [[0.0]]}, [[0.0]], params_version=5, trace_id=f"f{i}")
+    w.close()
+    rb = ReplayBuffer(100, n_envs=1)
+    out = ingest(tmp_path, rb, max_version_lag=2)
+    # serving version defaults to the freshest observed (5): the version-0
+    # samples lag by 5 > 2 and are dropped — but LEDGERED, so a re-run
+    # neither re-drops nor resurfaces them
+    assert out["samples"] == 4 and out["dropped_stale"] == 4
+    assert out["version_min"] == out["version_max"] == 5
+    assert out["serving_version"] == 5 and out["version_lag"] == 0
+    again = ingest(tmp_path, ReplayBuffer(10, n_envs=1),
+                   ledger=IngestLedger(tmp_path / "ingest_ledger.json"), max_version_lag=2)
+    assert again["samples"] == 0 and again["dropped_stale"] == 0
+    # a sample exactly AT the lag bound is admissible (the knob is "more
+    # than", per the recipe contract)
+    rb2 = ReplayBuffer(100, n_envs=1)
+    out2 = ingest(tmp_path / "nonexistent", rb2, max_version_lag=2)
+    assert out2["samples"] == 0  # empty root: a clean no-op, not an error
+
+
+def test_ingest_discovery_accepts_direct_and_replica_layouts(tmp_path):
+    _write_capture(tmp_path / "nested", sessions=1, steps=2)
+    direct = CaptureWriter(str(tmp_path / "direct" / "capture.jsonl"))
+    direct.record("d", {"x": [[0.0]]}, [[0.0]], 0)
+    direct.close()
+    assert len(discover_capture_streams(tmp_path / "nested")) == 1
+    assert len(discover_capture_streams(tmp_path / "direct")) == 1
+
+
+# -- the fine-tune recipe ------------------------------------------------------
+
+
+def test_recipe_finetunes_checkpoints_and_reloads(tmp_path):
+    from sheeprl_tpu.config import Config
+
+    _write_capture(tmp_path / "capture", sessions=2, steps=8)
+    ckpt = write_checkpoint(tmp_path / "checkpoint", 0,
+                            {"params": {"w": np.zeros((1,), np.float32)}})
+    reloads: list = []
+    cfg = Config({"flywheel": {"steps": 4, "batch_size": 4, "lr": 0.5,
+                               "max_version_lag": 4, "buffer_size": 100,
+                               "algo": "synthetic_counter",
+                               "capture_dir": str(tmp_path / "capture")}})
+    out = run_flywheel(
+        tmp_path, ckpt, cfg=cfg, rolling_reload=lambda: reloads.append(1) or [{"ok": True}]
+    )
+    assert out["ingest"]["samples"] == 16
+    assert out["finetune"]["steps"] == 4
+    assert out["checkpoint"].endswith("ckpt_4.ckpt")
+    assert reloads == [1]  # the in-process rolling-reload hook fired
+    assert out["reload"]["mode"] == "inproc"
+    # the flywheel's own telemetry stream landed under the run dir and is
+    # schema-valid (ingest + finetune + reload events)
+    stream = tmp_path / "flywheel" / "telemetry.jsonl"
+    events = [json.loads(l) for l in stream.read_text().splitlines()]
+    actions = [e.get("action") for e in events if e.get("event") == "flywheel"]
+    assert "ingest" in actions and "finetune" in actions and "reload" in actions
+    assert all(validate_event(e) == [] for e in events)
+    # a second turn with no new capture: a clean skip, not a crash
+    out2 = run_flywheel(tmp_path, ckpt, cfg=cfg)
+    assert out2["ingest"]["samples"] == 0 and "skipped" in out2
+
+
+def test_recipe_unknown_algo_is_a_loud_error():
+    from sheeprl_tpu.flywheel.recipe import build_finetune_step
+
+    with pytest.raises(ValueError, match="No finetune builder"):
+        build_finetune_step("definitely_not_registered")
+
+
+def test_cli_flywheel_composes_config(tmp_path, monkeypatch):
+    from sheeprl_tpu import cli
+
+    ckpt = write_checkpoint(tmp_path / "checkpoint", 0, {"params": {"w": np.zeros(1)}})
+    captured: dict = {}
+    import sheeprl_tpu.flywheel.recipe as recipe_mod
+
+    monkeypatch.setattr(
+        recipe_mod, "run_flywheel",
+        lambda run_dir, ckpt_path, cfg=None, **kw: captured.update(
+            run_dir=run_dir, ckpt=ckpt_path, cfg=cfg
+        ) or {"ok": True},
+    )
+    cli.flywheel([f"run_dir={tmp_path}", f"checkpoint_path={ckpt}", "flywheel.steps=99"])
+    assert captured["cfg"].select("flywheel.steps") == 99  # the override
+    assert captured["cfg"].select("flywheel.max_version_lag") == 4  # composed default
+    with pytest.raises(ValueError, match="run_dir"):
+        cli.flywheel([f"checkpoint_path={ckpt}"])
+
+
+# -- bench_compare FLYWHEEL gate ----------------------------------------------
+
+
+def _flywheel_record(value: float, p95: float = 10.0, overhead: float = 0.02,
+                     lag: float = 0.5, loss: int = 0) -> dict:
+    return {
+        "event": "flywheel_bench",
+        "metric": "m", "value": value, "unit": "flywheel ingest samples/sec (u)",
+        "vs_baseline": 1.0, "direction": "higher",
+        "ingest_samples_per_s": value, "capture_act_p95_ms": p95,
+        "baseline_act_p95_ms": p95 / (1 + overhead), "capture_overhead_frac": overhead,
+        "reload_to_fresh_act_s": lag, "trace_join_frac": 1.0, "acked_loss": loss,
+        "platform": "cpu",
+    }
+
+
+def _write_round(dirp: pathlib.Path, n: int, rec: dict, rc: int = 0) -> None:
+    (dirp / f"FLYWHEEL_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "rc": rc, "parsed": rec})
+    )
+
+
+def test_bench_compare_gates_flywheel_trajectory(tmp_path):
+    _write_round(tmp_path, 1, _flywheel_record(1000.0))
+    _write_round(tmp_path, 2, _flywheel_record(950.0))  # -5%: fine
+    fw = bench_compare.load_flywheel_trajectory(tmp_path)
+    report = bench_compare.compare([], flywheel=fw)
+    assert report["ok"], report["failures"]
+    # a 30% ingest-throughput slide is the regression
+    _write_round(tmp_path, 3, _flywheel_record(700.0))
+    fw = bench_compare.load_flywheel_trajectory(tmp_path)
+    report = bench_compare.compare([], flywheel=fw)
+    assert not report["ok"]
+    assert any("ingest samples/sec" in f for f in report["failures"])
+
+
+def test_bench_compare_flywheel_invariants_and_unusable_rounds(tmp_path):
+    _write_round(tmp_path, 1, _flywheel_record(1000.0))
+    # capture overhead creeping up by >5 points absolute fails
+    _write_round(tmp_path, 2, _flywheel_record(1000.0, overhead=0.09))
+    report = bench_compare.compare([], flywheel=bench_compare.load_flywheel_trajectory(tmp_path))
+    assert not report["ok"]
+    assert any("capture overhead" in f for f in report["failures"])
+    # nonzero acked loss fails regardless of history
+    _write_round(tmp_path, 3, _flywheel_record(1000.0, loss=2))
+    report = bench_compare.compare([], flywheel=bench_compare.load_flywheel_trajectory(tmp_path))
+    assert any("acked_loss" in f for f in report["failures"])
+    # an rc!=0 newest round is itself the failure and drops out of baselines
+    _write_round(tmp_path, 4, _flywheel_record(1000.0), rc=1)
+    report = bench_compare.compare([], flywheel=bench_compare.load_flywheel_trajectory(tmp_path))
+    assert any("unusable" in f for f in report["failures"])
+
+
+def test_bench_compare_auto_skips_pre_flywheel_trajectories(tmp_path):
+    # a trajectory with no FLYWHEEL artifacts at all: nothing to gate, ok
+    report = bench_compare.compare([], flywheel=bench_compare.load_flywheel_trajectory(tmp_path))
+    assert report["ok"]
+    # the repo's own recorded trajectory passes its gate
+    fw = bench_compare.load_flywheel_trajectory(REPO)
+    assert fw, "FLYWHEEL_r01.json missing from the repo root"
+    report = bench_compare.compare([], flywheel=fw)
+    assert report["ok"], report["failures"]
+    assert fw[-1]["trace_join_frac"] == 1.0
+    assert fw[-1]["acked_loss"] == 0
+
+
+# -- doctor -------------------------------------------------------------------
+
+
+def test_doctor_flywheel_staleness_red_green():
+    from sheeprl_tpu.diag.findings import run_detectors
+    from sheeprl_tpu.diag.timeline import Timeline
+
+    def tl_with_lag(lag: int) -> Timeline:
+        return Timeline([
+            {"event": "flywheel", "action": "ingest", "samples": 100,
+             "version_lag": lag, "dropped_stale": 5 if lag else 0},
+        ])
+
+    green = {f.code for f in run_detectors(tl_with_lag(0))}
+    assert "flywheel_staleness" not in green
+    red = [f for f in run_detectors(tl_with_lag(4)) if f.code == "flywheel_staleness"]
+    assert red and red[0].severity == "warning"
+    assert red[0].data["worst_lag"] == 4
+    assert "max_version_lag" in red[0].remediation
+
+
+def test_prometheus_mirrors_flywheel_events():
+    from sheeprl_tpu.diag.prometheus import Registry
+
+    reg = Registry(prefix="sheeprl")
+    reg.observe_event({"event": "flywheel", "action": "ingest", "samples": 42,
+                       "samples_per_s": 1000.0, "version_lag": 2, "dropped_stale": 1})
+    reg.observe_event({"event": "flywheel", "action": "reload", "step": 10})
+    text = reg.render()
+    assert "sheeprl_flywheel_ingest_total 1" in text
+    assert "sheeprl_flywheel_reload_total 1" in text
+    assert "sheeprl_flywheel_version_lag 2" in text
+    assert "sheeprl_flywheel_ingest_samples 42" in text
+
+
+# -- the miniature end-to-end loop --------------------------------------------
+
+
+def _drive(gw, expected, rounds, mismatches, versions):
+    from sheeprl_tpu.telemetry.tracing import make_traceparent, new_span_id, new_trace_id
+
+    for _ in range(rounds):
+        for sid in list(expected):
+            status, body, _ = gw.handle_act({
+                "obs": {"x": [[0.0]]},
+                "session_id": sid,
+                "reward": 1.0,
+                "traceparent": make_traceparent(new_trace_id(), new_span_id()),
+            })
+            if status != 200:
+                continue
+            action = float(body["actions"][0][0])
+            if action != float(expected[sid]):
+                mismatches.append((sid, expected[sid], action))
+            expected[sid] = int(action) + 1
+            versions.append(int(body.get("params_version") or 0))
+
+
+def test_flywheel_miniature_loop_e2e(tmp_path):
+    """The acceptance loop: synthetic counter-core sessions through the real
+    gateway (capture ON) → ingest (exactly-once) → one fine-tune burst →
+    the gateway's rolling reload → the bumped params_version served, with
+    zero acked-request mismatch across the swap."""
+    from sheeprl_tpu.config import Config, load_config_file
+    from sheeprl_tpu.gateway.cluster import build_cluster
+    from sheeprl_tpu.telemetry.sinks import JsonlSink
+
+    ckpt_dir = tmp_path / "checkpoint"
+    seed = write_checkpoint(ckpt_dir, 0, {"params": {"w": np.zeros((1,), np.float32)}})
+    capture_root = tmp_path / "capture"
+    cfg = Config({"gateway": load_config_file(
+        REPO / "sheeprl_tpu" / "configs" / "gateway" / "default.yaml").to_dict()})
+    for key, val in {
+        "gateway.replicas": 2,
+        "gateway.http.port": 0,
+        "gateway.supervisor.health_poll_s": 0.1,
+        "gateway.replica.ckpt_dir": str(ckpt_dir),
+        # reloads only through the forced rolling-reload poll
+        "gateway.replica.hot_reload.poll_interval_s": 3600.0,
+        "serve.capture.enabled": True,
+        "serve.capture.dir": str(capture_root),
+        "serve.capture.sample_frac": 1.0,
+    }.items():
+        cfg.set_path(key, val)
+    sink = JsonlSink(str(tmp_path / "telemetry.jsonl"))
+    gw = build_cluster(cfg, sink=sink, start=True, telemetry_dir=tmp_path)
+    manager = gw.manager
+    mismatches: list = []
+    versions: list = []
+    try:
+        assert len(manager.routable()) == 2
+        expected = {f"s{i:02d}": 0 for i in range(12)}
+        _drive(gw, expected, rounds=4, mismatches=mismatches, versions=versions)
+        assert mismatches == []
+        assert set(versions) == {0}
+
+        # one flywheel turn against the captured experience
+        fw_cfg = Config({"flywheel": {"steps": 3, "batch_size": 8, "lr": 0.5,
+                                      "max_version_lag": 4, "buffer_size": 1000,
+                                      "algo": "synthetic_counter",
+                                      "capture_dir": str(capture_root)}})
+        out = run_flywheel(
+            tmp_path, seed, cfg=fw_cfg,
+            rolling_reload=lambda: manager.rolling_reload(settle_timeout_s=30.0),
+            emit=sink.write,
+        )
+        assert out["ingest"]["samples"] == 48  # 12 sessions x 4 rounds
+        assert out["ingest"]["trace_join_frac"] == 1.0
+        assert out["checkpoint"].endswith("ckpt_3.ckpt")
+        reload_results = out["reload"]["results"]
+        assert all(r.get("swapped") for r in reload_results), reload_results
+
+        # serve again: counters CONTINUE (zero acked loss across the swap)
+        # and the bumped params_version is what answers
+        versions_after: list = []
+        _drive(gw, expected, rounds=2, mismatches=mismatches, versions=versions_after)
+        assert mismatches == []
+        assert set(versions_after) == {1}, versions_after
+        assert all(v >= 6 for v in expected.values())
+
+        # exactly-once: a pass after phase 2 ingests EXACTLY the new tail
+        # (12 sessions x 2 post-reload rounds), nothing from the first pass
+        again = ingest(capture_root, ReplayBuffer(100, n_envs=1),
+                       ledger=IngestLedger(capture_root / "ingest_ledger.json"))
+        assert again["samples"] == 24 and again["duplicates"] == 48
+        # ...and re-ingesting the very same segments is a no-op
+        third = ingest(capture_root, ReplayBuffer(10, n_envs=1),
+                       ledger=IngestLedger(capture_root / "ingest_ledger.json"))
+        assert third["samples"] == 0 and third["duplicates"] == 72
+    finally:
+        gw.stop()
+        manager.shutdown()
+        sink.close()
+    # the respawn-freshness path: a NEW replica seeded from the ckpt dir
+    # serves the fine-tuned version immediately (params_version lives in
+    # the policy, but the loaded step names the newest checkpoint)
+    from sheeprl_tpu.serve.reload import _list_checkpoints
+
+    steps = [s for s, _ in _list_checkpoints(ckpt_dir)]
+    assert steps == [0, 3]
+
+
+# -- review regressions --------------------------------------------------------
+
+
+def test_ingest_keeps_cross_replica_lineages_apart(tmp_path):
+    """The same session id served by TWO replicas (migration: both at
+    incarnation 0, both counters starting at 0) must ingest BOTH fragments
+    — the lineage key includes the replica, so one never dedups the other."""
+    for rid in (0, 1):
+        w = CaptureWriter(
+            str(tmp_path / f"replica_{rid:03d}" / "capture.jsonl"), replica_id=rid
+        )
+        for i in range(5):
+            assert w.record("migrant", {"x": [[float(i)]]}, [[float(i)]], 0,
+                            trace_id=f"r{rid}-{i}")
+        w.close()
+    rb = ReplayBuffer(100, n_envs=1)
+    out = ingest(tmp_path, rb)
+    assert out["samples"] == 10, out  # 5 from each replica, nothing deduped
+    again = ingest(tmp_path, ReplayBuffer(10, n_envs=1),
+                   ledger=IngestLedger(tmp_path / "ingest_ledger.json"))
+    assert again["samples"] == 0 and again["duplicates"] == 10
+
+
+def test_ingest_explicit_serving_version_measures_real_lag(tmp_path):
+    """With a real serving-version reference (the recipe probes the
+    gateway's health view), version_lag reports how far the freshest
+    captured sample trails what is actually being served — the signal the
+    doctor's flywheel_staleness finding fires on."""
+    _write_capture(tmp_path, sessions=1, steps=4, version=3)
+    out = ingest(tmp_path, ReplayBuffer(100, n_envs=1), serving_version=8)
+    assert out["serving_version"] == 8 and out["version_lag"] == 5
+    assert out["samples"] == 4  # no staleness gate: admitted, lag reported
+    # ...and the gate measured against the SERVING version, not the backlog
+    _write_capture(tmp_path / "b", sessions=1, steps=4, version=3)
+    out2 = ingest(tmp_path / "b", ReplayBuffer(100, n_envs=1),
+                  serving_version=8, max_version_lag=4)
+    assert out2["samples"] == 0 and out2["dropped_stale"] == 4
+
+
+def test_resolve_serving_version_prefers_explicit_then_gateway():
+    from sheeprl_tpu.config import Config
+    from sheeprl_tpu.flywheel.recipe import _resolve_serving_version
+
+    explicit = Config({"flywheel": {"serving_version": 7, "gateway_url": None}})
+    assert _resolve_serving_version(explicit) == 7
+    neither = Config({"flywheel": {"serving_version": None, "gateway_url": None}})
+    assert _resolve_serving_version(neither) is None
+    # a live gateway health view answers params_version_max
+    import http.server
+    import json as _json
+    import threading
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = _json.dumps({"params_version_max": 5}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        cfg = Config({"flywheel": {
+            "serving_version": None,
+            "gateway_url": f"http://127.0.0.1:{httpd.server_address[1]}",
+        }})
+        assert _resolve_serving_version(cfg) == 5
+    finally:
+        httpd.shutdown()
+
+
+def test_bc_fallback_is_reachable_with_a_policy_core(tmp_path):
+    """An unregistered algo with a supplied continuous-action PolicyCore
+    fine-tunes through the generic greedy-BC step."""
+    from sheeprl_tpu.config import Config
+    from sheeprl_tpu.serve.policy import PolicyCore
+
+    core = PolicyCore(
+        apply=lambda params, obs, state, key, greedy: (obs["x"] * params["w"], state, key),
+        extract_params=lambda p: p,
+        prepare=lambda raw, n: {"x": np.asarray(raw["x"], np.float32).reshape(n, -1)},
+        dummy_obs=lambda n: {"x": np.zeros((n, 1), np.float32)},
+        name="bc_linear",
+    )
+    w = CaptureWriter(str(tmp_path / "capture" / "replica_000" / "capture.jsonl"))
+    for i in range(16):
+        # obs x=1.0, target action 2.0: BC should pull w toward 2
+        w.record(f"s{i % 2}", {"x": [[1.0]]}, [[2.0]], 0, trace_id=f"t{i}")
+    w.close()
+    ckpt = write_checkpoint(tmp_path / "checkpoint", 0,
+                            {"params": {"w": np.zeros((1,), np.float32)}, "algo": "bc_linear"})
+    cfg = Config({"flywheel": {"steps": 50, "batch_size": 8, "lr": 0.2,
+                               "max_version_lag": 4, "buffer_size": 100,
+                               "capture_dir": str(tmp_path / "capture")}})
+    out = run_flywheel(tmp_path, ckpt, cfg=cfg, core=core)
+    assert out["finetune"]["loss"] < 1.0  # started at 4.0 (w=0 vs target 2)
+    import pickle
+
+    new = pickle.loads(open(out["checkpoint"], "rb").read())
+    assert 1.0 < float(np.asarray(new["params"]["w"])[0]) <= 2.5
+
+
+def test_recipe_resolves_finetune_step_before_consuming_the_ledger(tmp_path):
+    """A misconfigured turn (unregistered algo, no core) must fail BEFORE
+    the exactly-once ledger consumes the batch: after fixing the config,
+    a re-run trains on the full backlog instead of finding it 'already
+    ingested'. A crash between ingest and the checkpoint write heals the
+    same way — the durable ledger only advances once the ckpt landed."""
+    from sheeprl_tpu.config import Config
+
+    _write_capture(tmp_path / "capture", sessions=2, steps=6)
+    ckpt = write_checkpoint(tmp_path / "checkpoint", 0,
+                            {"params": {"w": np.zeros((1,), np.float32)}, "algo": "nope"})
+    cfg = Config({"flywheel": {"steps": 2, "batch_size": 4, "lr": 0.1,
+                               "max_version_lag": 4, "buffer_size": 100,
+                               "capture_dir": str(tmp_path / "capture")}})
+    with pytest.raises(ValueError, match="No finetune builder"):
+        run_flywheel(tmp_path, ckpt, cfg=cfg)
+    # nothing was durably consumed: the corrected turn gets every sample
+    cfg.set_path("flywheel.algo", "synthetic_counter")
+    out = run_flywheel(tmp_path, ckpt, cfg=cfg)
+    assert out["ingest"]["samples"] == 12 and out["ingest"]["duplicates"] == 0
+
+
+def test_version_lag_reports_even_when_everything_is_stale_dropped(tmp_path):
+    """The worst-staleness case — the ENTIRE backlog dropped by the gate —
+    must report its true lag (the doctor finding's trigger), not 0, and the
+    ledger's ingested total must not count the drops."""
+    _write_capture(tmp_path, sessions=1, steps=6, version=0)
+    ledger = IngestLedger(tmp_path / "ingest_ledger.json")
+    out = ingest(tmp_path, ReplayBuffer(100, n_envs=1), ledger=ledger,
+                 serving_version=10, max_version_lag=4)
+    assert out["samples"] == 0 and out["dropped_stale"] == 6
+    assert out["version_lag"] == 10  # svc 10 - freshest pre-gate sample 0
+    assert ledger.total_ingested == 0  # drops are consumed, never "ingested"
+    # ...and the drops are still ledgered: a re-run is a clean no-op
+    again = ingest(tmp_path, ReplayBuffer(10, n_envs=1), ledger=ledger,
+                   serving_version=10, max_version_lag=4)
+    assert again["dropped_stale"] == 0 and again["duplicates"] == 6
+
+
+def test_synthetic_replica_honors_hot_reload_enabled_flag(tmp_path):
+    from sheeprl_tpu.gateway.replica import _build_replica_server
+
+    write_checkpoint(tmp_path / "checkpoint", 3, {"params": {"w": np.full(1, 7.0, np.float32)}})
+    spec = {"mode": "synthetic", "ckpt_dir": str(tmp_path / "checkpoint"),
+            "buckets": [1, 2]}
+    pinned = _build_replica_server(dict(spec, hot_reload={"enabled": False}))
+    try:
+        assert pinned.reloader is None  # A/B pinning: no self-poll swaps
+        # ...but spawn-time seeding from the newest ckpt still happens
+        assert float(np.asarray(pinned.policy.current_params()[0]["w"])[0]) == 7.0
+    finally:
+        pinned.stop()
+    watching = _build_replica_server(dict(spec, hot_reload={"enabled": True}))
+    try:
+        assert watching.reloader is not None and watching.reloader.loaded_step == 3
+    finally:
+        watching.stop()
+
+
+def test_ingest_aligns_rewards_to_the_action_they_scored(tmp_path):
+    """A capture record's own reward field is the client's report for the
+    PREVIOUS action (outcomes are only known on the next request), so the
+    buffer row for step t must take reward/done from record t+1 — and the
+    lineage's final record, whose outcome nobody reported yet, lands
+    reward-less and counted."""
+    w = CaptureWriter(str(tmp_path / "replica_000" / "capture.jsonl"))
+    # step 0: first request, no previous action to report on
+    assert w.record("s", {"x": [[0.0]]}, [[0.0]], 0, trace_id="t0")
+    # step 1 reports action 0's outcome; step 2 reports action 1's (terminal)
+    assert w.record("s", {"x": [[1.0]]}, [[1.0]], 0, trace_id="t1", reward=10.0)
+    assert w.record("s", {"x": [[2.0]]}, [[2.0]], 0, trace_id="t2", reward=20.0, done=True)
+    w.close()
+    rb = ReplayBuffer(10, n_envs=1)
+    out = ingest(tmp_path, rb)
+    assert out["samples"] == 3 and out["unrewarded_tails"] == 1
+    rewards = rb["rewards"][:3, 0, 0].tolist()
+    dones = rb["dones"][:3, 0, 0].tolist()
+    steps = rb["capture_step"][:3, 0, 0].tolist()
+    by_step = {int(s): (r, d) for s, r, d in zip(steps, rewards, dones)}
+    assert by_step[0] == (10.0, 0.0)  # action 0 scored 10, episode continued
+    assert by_step[1] == (20.0, 1.0)  # action 1 scored 20 and ended it
+    assert by_step[2] == (0.0, 0.0)   # the tail: outcome not yet reported
+
+
+def test_cluster_refuses_capture_enabled_with_no_directory():
+    from sheeprl_tpu.config import Config, load_config_file
+    from sheeprl_tpu.gateway.cluster import build_cluster
+
+    cfg = Config({"gateway": load_config_file(
+        REPO / "sheeprl_tpu" / "configs" / "gateway" / "default.yaml").to_dict()})
+    cfg.set_path("serve.capture.enabled", True)  # dir null, no telemetry_dir
+    with pytest.raises(ValueError, match="no capture directory"):
+        build_cluster(cfg, start=False, telemetry_dir=None)

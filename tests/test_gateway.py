@@ -619,3 +619,79 @@ def test_gateway_sheds_deterministic_traffic_first_and_stats_count_it():
     # normal traffic is admitted past admission (and then finds no replica)
     status, body, _ = gw.handle_act({"obs": {"x": [[0.0]]}})
     assert status == 503 and "no replica" in body["error"]
+
+
+# -- replica-side idempotency (the first-request in-doubt window, closed) -----
+
+
+def test_policy_server_idempotent_replay_shields_duplicate_forwards():
+    """The same (session, request_id) forwarded twice steps the session
+    ONCE: the second delivery is answered verbatim from the replay cache —
+    the replica half of the duplicate-forward shield."""
+    policy = _counter_policy()
+    server = PolicyServer(policy, MicroBatcher(policy, max_wait_ms=0.0), port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        req = {"obs": {"x": [[0.0]]}, "session_id": "s", "request_id": "r1",
+               "return_state": True}
+        status, first = _post_json(f"{base}/v1/act", req)
+        assert status == 200 and first["actions"] == [[0.0]]
+        # the duplicate: identical response, counter did NOT advance
+        status, replay = _post_json(f"{base}/v1/act", req)
+        assert status == 200 and replay == first
+        assert server.idempotent_replays == 1
+        # even a duplicate that carries a rehydration blob (the gateway's
+        # force_state retry) must not rewind the cache: the replay wins and
+        # the NEXT request continues from the one real step
+        status, replay2 = _post_json(f"{base}/v1/act", dict(req, session_state=first["session_state"]))
+        assert status == 200 and replay2["actions"] == [[0.0]]
+        status, nxt = _post_json(f"{base}/v1/act", dict(req, request_id="r2"))
+        assert status == 200 and nxt["actions"] == [[1.0]]
+        # a request WITHOUT an id never touches the cache
+        status, plain = _post_json(f"{base}/v1/act", {"obs": {"x": [[0.0]]}, "session_id": "s"})
+        assert status == 200 and plain["actions"] == [[2.0]]
+    finally:
+        server.stop()
+
+
+def test_gateway_retry_after_executed_timeout_never_double_steps(tmp_path):
+    """Regression for the documented first-request in-doubt window: the
+    FIRST forward of a session executes replica-side but its ack is lost
+    (chaos-delayed — the transport dies after delivery). The gateway's
+    retry carries the same request_id, so the replica replays the original
+    response instead of stepping again: the acked trajectory starts at 0
+    and continues 1, 2, ... with no hidden step."""
+    policy = _counter_policy()
+    server = PolicyServer(policy, MicroBatcher(policy, max_wait_ms=0.0), port=0)
+    server.start()
+    try:
+        handle = _handle(0)
+        handle.port = server.port
+        gw = Gateway(_FakeManager([handle]), broker=SessionBroker())
+        real_post = Gateway._post
+        chaos = {"armed": True}
+
+        def delayed_ack_post(url, body, timeout_s):
+            if chaos["armed"] and body.get("session_id") == "s":
+                chaos["armed"] = False
+                # the request is DELIVERED and EXECUTED; the ack is lost
+                real_post(gw, url, body, timeout_s)
+                raise OSError("simulated: response lost after execution")
+            return real_post(gw, url, body, timeout_s)
+
+        gw._post = delayed_ack_post
+        status, body, _ = gw.handle_act({"obs": {"x": [[0.0]]}, "session_id": "s"})
+        assert status == 200
+        # the retry replayed the ORIGINAL first step: action 0, not 1
+        assert body["actions"] == [[0.0]]
+        assert gw.stats.snapshot()["failovers"] == 1
+        assert server.idempotent_replays == 1
+        # continuity: the next requests see 1 then 2 — no skipped step
+        for want in (1.0, 2.0):
+            status, body, _ = gw.handle_act({"obs": {"x": [[0.0]]}, "session_id": "s"})
+            assert status == 200 and body["actions"] == [[want]]
+        # and the broker's acked state matches the served trajectory
+        assert len(gw.broker) == 1
+    finally:
+        server.stop()
