@@ -23,7 +23,14 @@ Comparison rules (normalization — the trajectory is heterogeneous):
   and `mfu` — each compared only when BOTH sides carry it — within
   ``(1 - threshold)`` of the best comparable prior record;
 * `MULTICHIP_*.json`: the newest record must not flip `ok` to false when
-  any prior round passed;
+  any prior round passed; rounds recorded by `scripts/dryrun_multichip.py`
+  additionally carry **per-chip accounting** — `per_chip_sps` /
+  `per_chip_mfu` (higher-is-better) and `param_bytes_per_chip`
+  (lower-is-better: the whole point of the multi-axis mesh is that each
+  chip holds LESS) — gated against the best prior round with the same
+  `unit` (device count + mesh shape) and platform class. Correctness-only
+  rounds from before the sharding subsystem carry none of these fields, so
+  the per-chip gates auto-skip against them;
 * **extra legs** (`extra_metrics` on a record — the compute-only dv3_step
   leg, the fleet e2e leg `env steps/sec (fleet)`): every leg of the newest
   record gates on its OWN unit + platform class against the best comparable
@@ -111,6 +118,16 @@ FLYWHEEL_GATED_FIELDS = (
     ("capture_act_p95_ms", "capture-enabled act p95", "lower", "rel"),
     ("capture_overhead_frac", "capture overhead on act p95", "lower", "abs"),
     ("reload_to_fresh_act_s", "reload-to-first-improved-act lag", "lower", "rel"),
+)
+# MULTICHIP_*.json per-chip accounting (scripts/dryrun_multichip.py): SPS
+# and MFU per chip must not slide, and param bytes per chip must not GROW —
+# a regression toward replication is a memory-ceiling regression even when
+# throughput holds. Pre-sharding rounds carry none of these, so every gate
+# auto-skips against them (the ok→fail flip check still applies).
+MULTICHIP_GATED_FIELDS = (
+    ("per_chip_sps", "multichip per-chip SPS", "higher", "rel"),
+    ("per_chip_mfu", "multichip per-chip MFU", "higher", "rel"),
+    ("param_bytes_per_chip", "multichip param bytes per chip", "lower", "rel"),
 )
 # absolute shed-rate increase vs the best comparable prior that fails the gate
 DEFAULT_SHED_DELTA = 0.05
@@ -473,6 +490,26 @@ def compare(
         else:
             cmp["verdict"] = "ok" if newest_mc.get("ok") else "skipped (never passed)"
         report["comparisons"].append(cmp)
+
+        # per-chip gates (dryrun_multichip rounds): judged only against OK
+        # priors of the same unit (device count + mesh shape) and platform
+        # class; correctness-only rounds predate the fields → auto-skip
+        mc_priors = [
+            m
+            for m in multichip[:-1]
+            if m.get("ok")
+            and m.get("unit") == newest_mc.get("unit")
+            and platform_class(m) == platform_class(newest_mc)
+        ]
+        _gate_fields(
+            report,
+            newest_mc,
+            mc_priors,
+            threshold,
+            newest_mc["_file"],
+            unit="multichip",
+            fields=MULTICHIP_GATED_FIELDS,
+        )
     return report
 
 

@@ -1,0 +1,201 @@
+"""Multi-chip DreamerV3 dryrun with per-chip perf accounting.
+
+The MULTICHIP_r01..r05 artifacts are correctness-only: one train step on a
+dp mesh, `ok` iff the losses came back finite. That told us sharding
+*works*, never what it *costs* — which is exactly how the 1-D-mesh HBM
+ceiling stayed invisible for ten PRs. This leg runs the real DreamerV3
+train program over a named ``(dp, fsdp, tp)`` mesh (parallel/sharding.py)
+and records:
+
+* **per-chip SPS** — replayed frames/s through the train step, per chip;
+* **per-chip MFU** — model FLOPs (XLA cost analysis of the lowered train
+  program) against the per-chip peak (vendor table on TPU, measured matmul
+  on the CPU stand-in — telemetry/throughput.py);
+* **per-chip param + optimizer-state bytes** from the rule engine's
+  ShardingReport, next to the fully-replicated baseline — the memory win
+  the multi-axis mesh exists for;
+* zero-retrace-after-warmup and finite-loss checks (the old contract).
+
+The record is the MULTICHIP_r*.json wrapper `scripts/bench_compare.py`
+gates: per_chip_sps / per_chip_mfu higher-is-better, param_bytes_per_chip
+lower-is-better, auto-skipped against pre-sharding rounds that never
+carried them.
+
+Usage:
+    python scripts/dryrun_multichip.py --devices 8 --fsdp 2 --tp 2 \
+        --out MULTICHIP_r06.json
+    python scripts/dryrun_multichip.py --devices 8        # pure-dp, stdout
+
+By default self-provisions a virtual n-device CPU mesh; set
+SHEEPRL_DRYRUN_REAL_DEVICES=1 on a host with real chips.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_dryrun(
+    n_devices: int,
+    dp: int = -1,
+    fsdp: int = 1,
+    tp: int = 1,
+    steps: int = 6,
+    warmup: int = 3,
+    seq: int = 4,
+) -> dict:
+    if not os.environ.get("SHEEPRL_DRYRUN_REAL_DEVICES"):
+        from sheeprl_tpu.utils.virtual_mesh import force_virtual_cpu_mesh
+
+        force_virtual_cpu_mesh(n_devices)
+
+    import jax
+    import numpy as np
+
+    from __graft_entry__ import _dv3_setup
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import build_optimizers, make_train_fn
+    from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
+    from sheeprl_tpu.telemetry.throughput import flops_of_lowered, mfu, peak_flops_record
+
+    from sheeprl_tpu.parallel import resolve_mesh_shape
+
+    t0 = time.perf_counter()
+    r_dp, r_fsdp, r_tp = resolve_mesh_shape(n_devices, dp=dp, fsdp=fsdp, tp=tp)
+    mesh_sizes = {"dp": r_dp, "fsdp": r_fsdp, "tp": r_tp}
+    # 2 sequences per data-parallel chip group: per-chip work stays constant
+    # across mesh shapes, so per-chip SPS compares like for like
+    batch = 2 * r_dp * r_fsdp
+    cfg, dist, wm, actor, critic, params, actions_dim = _dv3_setup(
+        n_devices, batch, mesh={"dp": dp, "fsdp": fsdp, "tp": tp}
+    )
+    assert len(dist.mesh.devices.flatten()) == n_devices
+
+    # params + optimizer state through the rule engine (pure-dp meshes
+    # included — the report's per-chip accounting is the point of this leg)
+    params = dist.shard_params(params)
+    txs, opt_states = build_optimizers(cfg, params)
+    opt_states = dist.shard_opt_state(opt_states)
+    reports = {r.group: r for r in dist.take_sharding_reports()}
+    moments = init_moments()
+    train = make_train_fn(wm, actor, critic, txs, cfg, False, actions_dim)
+
+    rng = np.random.default_rng(0)
+
+    def make_data():
+        data = {
+            "rgb": np.asarray(rng.integers(0, 255, (seq, batch, 64, 64, 3), np.uint8)),
+            "actions": np.eye(4, dtype=np.float32)[rng.integers(0, 4, (seq, batch))],
+            "rewards": np.asarray(rng.standard_normal((seq, batch, 1)), np.float32),
+            "terminated": np.zeros((seq, batch, 1), np.float32),
+            "truncated": np.zeros((seq, batch, 1), np.float32),
+            "is_first": np.zeros((seq, batch, 1), np.float32),
+        }
+        sh = dist.shard_batch_axis(2)
+        return {k: jax.device_put(v[None], sh) for k, v in data.items()}
+
+    # whole-mesh model FLOPs per train call, from the lowered program
+    keys = jax.random.split(jax.random.key(1), 1)
+    flops_per_step = flops_of_lowered(train.lower(params, opt_states, moments, make_data(), keys))
+
+    metrics = None
+    cache = getattr(train, "_cache_size", None)
+    cache_after_warmup = None
+    for i in range(warmup + steps):
+        if i == warmup:
+            # warmup absorbs the output-sharding fixed-point compiles (the
+            # first call's GSPMD-propagated outputs re-enter as inputs; the
+            # layout stabilizes within two calls) — retraces are counted
+            # strictly AFTER it
+            jax.block_until_ready((params, opt_states))
+            cache_after_warmup = cache() if callable(cache) else None
+            t_run = time.perf_counter()
+        params, opt_states, moments, metrics = train(
+            params, opt_states, moments, make_data(), jax.random.split(jax.random.key(2 + i), 1)
+        )
+    jax.block_until_ready(params)
+    wall = time.perf_counter() - t_run
+
+    finite = all(np.isfinite(np.asarray(v)).all() for v in jax.tree.leaves(metrics))
+    retraces = (cache() - cache_after_warmup) if cache_after_warmup is not None else None
+
+    frames = steps * seq * batch
+    sps = frames / wall
+    peak = peak_flops_record(dist.local_device)
+    per_chip_mfu = (
+        mfu(flops_per_step, steps / wall, peak["peak_flops"], n_devices)
+        if flops_per_step and peak.get("peak_flops")
+        else None
+    )
+
+    p_rep, o_rep = reports.get("params"), reports.get("opt_state")
+    metric_means = {
+        k: float(np.asarray(v).mean()) for k, v in (metrics or {}).items()
+    }
+    mesh_tag = "x".join(f"{ax}{mesh_sizes.get(ax, 1)}" for ax in ("dp", "fsdp", "tp"))
+    rec = {
+        "kind": "dryrun_multichip",
+        "n_devices": n_devices,
+        "unit": f"dv3 replayed frames/s (n={n_devices} {mesh_tag})",
+        "mesh": {ax: int(sz) for ax, sz in mesh_sizes.items()},
+        "platform": jax.default_backend(),
+        "device_kind": getattr(dist.local_device, "device_kind", ""),
+        "ok": bool(finite) and (retraces in (0, None)),
+        "skipped": False,
+        "rc": 0 if finite and retraces in (0, None) else 1,
+        "steps": steps,
+        "batch": batch,
+        "seq": seq,
+        "sps": round(sps, 3),
+        "per_chip_sps": round(sps / n_devices, 3),
+        "per_chip_mfu": per_chip_mfu,
+        "flops_per_step": flops_per_step,
+        "peak_flops_basis": peak.get("peak_flops_basis"),
+        "retraces_after_warmup": retraces,
+        "param_bytes_per_chip": p_rep.bytes_per_chip if p_rep else None,
+        "opt_bytes_per_chip": o_rep.bytes_per_chip if o_rep else None,
+        # the fully-replicated baseline: what EVERY chip would hold on the
+        # 1-D dp mesh — the number param_bytes_per_chip must beat
+        "replicated_param_bytes": p_rep.total_bytes if p_rep else None,
+        "replicated_opt_bytes": o_rep.total_bytes if o_rep else None,
+        "elapsed_seconds": round(time.perf_counter() - t0, 1),
+        "tail": (
+            f"dryrun_multichip({n_devices}, {mesh_tag}) "
+            f"{'OK' if finite else 'NON-FINITE'} — per_chip_sps="
+            f"{sps / n_devices:.2f} param_bytes_per_chip="
+            f"{p_rep.bytes_per_chip if p_rep else '?'} "
+            f"(replicated {p_rep.total_bytes if p_rep else '?'}) — metrics: {metric_means}"
+        ),
+    }
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--dp", type=int, default=-1, help="dp axis size (-1 = auto-fill)")
+    ap.add_argument("--fsdp", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=6, help="timed train calls after warmup")
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--out", default=None, help="write the MULTICHIP_r*.json wrapper here")
+    args = ap.parse_args()
+
+    rec = run_dryrun(
+        args.devices, dp=args.dp, fsdp=args.fsdp, tp=args.tp, steps=args.steps, warmup=args.warmup
+    )
+    print(json.dumps(rec, indent=1))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(rec, fh, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+    sys.exit(0 if rec["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -45,7 +45,7 @@ from ...ops import pallas_gru as pg
 from ...ops.transforms import unrolled_cumprod
 from ...optim import clipped
 from ...parallel import Distributed
-from ...parallel.mesh import maybe_shard_opt_state
+from ...parallel.mesh import maybe_shard_opt_state, maybe_shard_params
 from ...parallel.placement import make_param_mirror, player_device
 from ...telemetry import Telemetry
 from ...telemetry import xla as _xla
@@ -538,6 +538,10 @@ def main(dist: Distributed, cfg: Config) -> None:
     wm, actor, critic, params = build_agent(
         dist, cfg, obs_space, actions_dim, is_continuous, init_key, state["params"] if state else None
     )
+    # multi-axis mesh (fabric.mesh.fsdp/tp > 1): world-model params flow
+    # through the rule engine's inferred specs instead of replication; a
+    # strict no-op on pure-dp meshes (the bit-identical 1-D path)
+    params = maybe_shard_params(cfg, dist, params)
 
     txs, opt_states = build_optimizers(cfg, params)
     if state:
@@ -573,6 +577,12 @@ def main(dist: Distributed, cfg: Config) -> None:
 
     telem = Telemetry.setup(cfg, log_dir, rank, logger=logger, aggregator_keys=AGGREGATOR_KEYS)
     aggregator = telem.aggregator
+    # the mesh layout is a telemetry artifact: every inferred spec (and the
+    # per-chip bytes accounting) lands in the JSONL stream as `sharding`
+    # events — doctor's replicated_giant reads them
+    for _rep in dist.take_sharding_reports():
+        for _ev in _rep.events():
+            telem.emit(_ev)
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
     guard = RunGuard.setup(cfg, ckpt, telem, log_dir)
     ckpt = guard.ckpt
@@ -580,7 +590,10 @@ def main(dist: Distributed, cfg: Config) -> None:
     if state and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
 
-    batch_size = int(cfg.algo.per_rank_batch_size) * dist.world_size
+    # batches shard over the DATA axes only (dp × fsdp): under tensor
+    # parallelism the tp replicas see the same batch, so the global batch
+    # does not scale with tp (== world_size on every non-tp mesh)
+    batch_size = int(cfg.algo.per_rank_batch_size) * dist.data_parallel_size
     total_steps = int(cfg.algo.total_steps) if not cfg.dry_run else 4 * num_envs
     learning_starts = int(cfg.algo.learning_starts) if not cfg.dry_run else 0
     policy_step = state["policy_step"] if state else 0

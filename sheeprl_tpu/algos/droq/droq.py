@@ -276,7 +276,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             if g > 0:
                 with telem.span("Time/train_time"):
                     sample = rb.sample(batch_size * g)
-                    mb_sharding = dist.sharding(None, "dp")
+                    mb_sharding = dist.shard_batch_axis(1)
                     critic_batches = {
                         k: jax.device_put(np.asarray(v).reshape(g, batch_size, *v.shape[2:]), mb_sharding)
                         for k, v in sample.items()

@@ -278,7 +278,7 @@ def main(dist: Distributed, cfg: Config) -> None:
             telem.tick(policy_step)
 
             with telem.span("Time/train_time"):
-                mb_sharding = dist.sharding(None, "dp")
+                mb_sharding = dist.shard_batch_axis(1)
                 device_batches = {
                     k: jax.device_put(v, mb_sharding) for k, v in batches.items()
                 }

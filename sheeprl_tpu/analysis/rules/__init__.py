@@ -9,6 +9,7 @@ from typing import List
 from ..engine import Rule
 from .donation import UseAfterDonateRule
 from .host_sync import HostSyncRule
+from .pspec import PspecLiteralRule
 from .retrace import RetraceHazardRule
 from .rng import RngReuseRule
 from .sockets import SocketTimeoutRule
@@ -23,6 +24,7 @@ RULE_CLASSES = [
     ThreadSharedStateRule,
     TelemetrySchemaRule,
     SocketTimeoutRule,
+    PspecLiteralRule,
 ]
 
 

@@ -1,0 +1,143 @@
+"""pspec-literal: partition specs must come from the rule engine.
+
+The mesh subsystem (``sheeprl_tpu/parallel/``) is the ONE place that knows
+the mesh's axis names, their sizes, and the divisibility/degeneracy rules
+(size-1 axes dropped, odd shapes replicated). A ``PartitionSpec(...)``
+constructed at a call site — or a bare axis-name string literal (``"dp"`` /
+``"fsdp"`` / ``"tp"``) handed to a sharding API — bakes one mesh layout
+into code that must work on every layout: it breaks silently the first
+time someone runs with ``fabric.mesh.tp=2`` (the batch lands sharded over
+an axis the spec never mentions, or worse, a literal names an axis the
+mesh doesn't have and the run crashes). The refactor that built the rule
+engine converted every such site to ``Distributed.shard_batch_axis`` /
+``shard_params`` / ``shard_opt_state``; this rule keeps new ones out.
+
+Flagged outside ``sheeprl_tpu/parallel/``:
+
+* any call resolving to ``jax.sharding.PartitionSpec`` /
+  ``jax.sharding.NamedSharding`` / ``jax.sharding.PositionalSharding``;
+* a mesh-axis string literal (``dp``/``fsdp``/``tp``), including inside
+  tuples/lists, passed to a sharding-shaped callee: ``.sharding(...)``,
+  ``with_sharding_constraint``, ``shard_map``, the ``jax.lax`` collectives
+  (``psum``/``pmean``/``all_gather``/...), or any ``axis_name=`` keyword.
+
+Suppress a deliberate exception with ``# lint: ok[pspec-literal] <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Tuple
+
+from ..engine import Finding, ModuleContext, Rule
+
+AXIS_NAMES = {"dp", "fsdp", "tp"}
+SPEC_CTORS = {
+    "jax.sharding.PartitionSpec",
+    "jax.sharding.NamedSharding",
+    "jax.sharding.PositionalSharding",
+    "jax.experimental.pjit.PartitionSpec",
+}
+# terminal callee names whose string args are axis names, not data
+SHARDING_CALLEES = {
+    "sharding",
+    "PartitionSpec",
+    "NamedSharding",
+    "with_sharding_constraint",
+    "shard_map",
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "all_gather",
+    "all_to_all",
+    "ppermute",
+    "axis_index",
+}
+
+
+def _terminal_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _axis_literals(node: ast.AST) -> List[Tuple[str, int]]:
+    """(axis, line) for every mesh-axis string constant under ``node``
+    (tuples/lists included — ``P(None, ("dp", "fsdp"))`` is two hits)."""
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) and sub.value in AXIS_NAMES:
+            out.append((sub.value, sub.lineno))
+    return out
+
+
+class PspecLiteralRule(Rule):
+    """PartitionSpec / mesh-axis string literals constructed outside sheeprl_tpu/parallel/ (specs come from the rule engine)."""
+
+    rule_id = "pspec-literal"
+
+    def applies(self, path) -> bool:
+        # the mesh subsystem IS the engine — everything else is a call site
+        return "parallel" not in path.parts
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = ctx.call_dotted(node) or ""
+            if dotted in SPEC_CTORS:
+                yield Finding(
+                    self.rule_id,
+                    str(ctx.path),
+                    node.lineno,
+                    f"`{dotted.rsplit('.', 1)[-1]}(...)` constructed outside "
+                    "sheeprl_tpu/parallel/ — partition specs must come from the "
+                    "rule engine, which owns axis names, divisibility and the "
+                    "degenerate-mesh normalization",
+                    remediation=(
+                        "use Distributed.shard_batch_axis / batch_sharding for "
+                        "batches and shard_params / shard_opt_state for state "
+                        "(sheeprl_tpu/parallel/sharding.py); a deliberate "
+                        "exception needs `# lint: ok[pspec-literal] <reason>`"
+                    ),
+                )
+                continue
+            callee = _terminal_name(node.func)
+            if callee in SHARDING_CALLEES or dotted.startswith("jax.sharding."):
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    for axis, line in _axis_literals(arg):
+                        yield Finding(
+                            self.rule_id,
+                            str(ctx.path),
+                            line,
+                            f"mesh-axis literal '{axis}' passed to `{callee}(...)` "
+                            "outside sheeprl_tpu/parallel/ — the axis layout is the "
+                            "rule engine's to decide (this literal is wrong the "
+                            "moment fabric.mesh changes shape)",
+                            remediation=(
+                                "ask the engine for the placement instead "
+                                "(Distributed.shard_batch_axis(axis) for batches); "
+                                "suppress a deliberate exception with "
+                                "`# lint: ok[pspec-literal] <reason>`"
+                            ),
+                        )
+            else:
+                # axis_name= keywords on anything (e.g. custom collectives)
+                for kw in node.keywords:
+                    if kw.arg in ("axis_name", "axis_names"):
+                        for axis, line in _axis_literals(kw.value):
+                            yield Finding(
+                                self.rule_id,
+                                str(ctx.path),
+                                line,
+                                f"mesh-axis literal '{axis}' as {kw.arg}= outside "
+                                "sheeprl_tpu/parallel/ — axis names belong to the "
+                                "rule engine",
+                                remediation=(
+                                    "thread the axis through the Distributed "
+                                    "helpers; suppress a deliberate exception with "
+                                    "`# lint: ok[pspec-literal] <reason>`"
+                                ),
+                            )

@@ -420,7 +420,7 @@ class ShardedDeviceRingPrefetcher(_ShardedRing):
             )
             for d in range(D)
         ]
-        self._batch_sharding = dist.sharding(None, None, "dp")  # [G, T, B, ...]
+        self._batch_sharding = dist.shard_batch_axis(2)  # [G, T, B, ...]
         self._batch_axis = 2
         self._staged: Optional[tuple] = None
 
@@ -633,7 +633,7 @@ class ShardedDeviceUniformRingPrefetcher(_ShardedRing):
             )
             for d in range(D)
         ]
-        self._batch_sharding = dist.sharding(None, "dp")  # [G, B, ...]
+        self._batch_sharding = dist.shard_batch_axis(1)  # [G, B, ...]
         self._batch_axis = 1
         self._staged: Optional[tuple] = None
 
@@ -704,6 +704,14 @@ def _sharded_or_fallback(cfg: Any, dist: Any, rb: Any, batch_size: int, make_sha
             "sharded device ring requires all mesh devices to be "
             "process-local (multi-host meshes stay host-staged)"
         )
+    elif not getattr(dist, "is_pure_dp", True):
+        # multi-axis mesh (fsdp/tp): the ring's one-env-block-per-device
+        # layout IS the pure-dp batch placement; fsdp/tp batches need the
+        # engine's (dp, fsdp)-sharded staging instead
+        msg = (
+            f"sharded device ring is pure-dp only (mesh is dp={dist.dp} "
+            f"fsdp={dist.fsdp} tp={dist.tp}); multi-axis meshes stay host-staged"
+        )
     elif rb.n_envs % dist.world_size == 0 and batch_size % dist.world_size == 0:
         return make_sharded()
     else:
@@ -766,7 +774,7 @@ def make_sequential_prefetcher(
             # warmup hole: a device block with no ready sub-buffer serves
             # host-staged batches instead of raising (satellite ADVICE r5)
             return sharded.attach_fallback(host_sample_fn)
-    return StagedPrefetcher(host_sample_fn, dist.sharding(None, None, "dp"))
+    return StagedPrefetcher(host_sample_fn, dist.shard_batch_axis(2))
 
 
 def make_uniform_prefetcher(
@@ -813,4 +821,4 @@ def make_uniform_prefetcher(
             # warmup hole: a device block with no ready sub-buffer serves
             # host-staged batches instead of raising (satellite ADVICE r5)
             return sharded.attach_fallback(host_sample_fn)
-    return StagedPrefetcher(host_sample_fn, dist.sharding(None, "dp"))
+    return StagedPrefetcher(host_sample_fn, dist.shard_batch_axis(1))

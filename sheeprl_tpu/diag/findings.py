@@ -822,6 +822,67 @@ def detect_flywheel_staleness(tl: Timeline, cfg: Any = None) -> List[Finding]:
     ]
 
 
+def detect_replicated_giant(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """A multi-axis mesh is paying for chips it isn't using: the run's
+    ``sharding`` events (parallel/sharding.py SpecEngine decisions) show a
+    parameter/optimizer-state leaf above
+    ``diag.sharding.max_replicated_bytes`` left FULLY replicated even though
+    the mesh has an fsdp or tp axis to shard it over. Every chip holds the
+    whole leaf — exactly the single-chip HBM ceiling the mesh exists to
+    break. Names the leaf path and the rule that made the call (usually a
+    divisibility fallback: an odd dimension no axis divides)."""
+    max_bytes = int(_sel(cfg, "diag.sharding.max_replicated_bytes", 64 * 1024 * 1024))
+    leaves = [rec for rec in tl.of("sharding") if rec.get("action") == "leaf"]
+    giants = [
+        rec
+        for rec in leaves
+        if rec.get("spec") == "replicated"
+        and int(rec.get("bytes") or 0) >= max_bytes
+        # only a mesh with a non-trivial fsdp/tp axis COULD have sharded it
+        and int(rec.get("fsdp") or 1) * int(rec.get("tp") or 1) > 1
+    ]
+    if not giants:
+        return []
+    worst = max(giants, key=lambda rec: int(rec.get("bytes") or 0))
+    named = ", ".join(
+        f"{rec.get('path')} ({int(rec.get('bytes') or 0) / 2**20:.1f} MiB, rule "
+        f"{rec.get('rule')!r}: {rec.get('reason')})"
+        for rec in giants[:3]
+    )
+    return [
+        Finding(
+            code="replicated_giant",
+            severity="warning",
+            title=(
+                f"{len(giants)} leaf(ves) over "
+                f"{max_bytes / 2**20:.0f} MiB fully replicated on a multi-axis mesh"
+            ),
+            detail=(
+                f"Worst: {worst.get('path')} — "
+                f"{int(worst.get('bytes') or 0) / 2**20:.1f} MiB on EVERY chip "
+                f"(mesh dp={worst.get('dp')} fsdp={worst.get('fsdp')} tp={worst.get('tp')}). "
+                f"Affected: {named}."
+            ),
+            remediation=(
+                "Check the quoted rule/reason: a divisibility fallback means no "
+                "mesh axis divides the leaf's dimensions — pick fabric.mesh.fsdp/tp "
+                "sizes that divide the model's widths, or pad the layer. A "
+                "'shape-fallback ... under min_shard_size' reason on a giant leaf "
+                "means fabric.mesh.min_shard_size is set too high. Add a SpecRule "
+                "matching the path if the default rules misclassify it "
+                "(parallel/sharding.py DEFAULT_PARAM_RULES)."
+            ),
+            data={
+                "giants": [
+                    {k: rec.get(k) for k in ("path", "bytes", "rule", "reason", "group")}
+                    for rec in giants[:10]
+                ],
+                "max_replicated_bytes": max_bytes,
+            },
+        )
+    ]
+
+
 def detect_incomplete_stream(tl: Timeline, cfg: Any = None) -> List[Finding]:
     """No shutdown event: the process died without closing telemetry — a
     crash, OOM-kill or external SIGKILL (a clean preemption still writes
@@ -869,6 +930,7 @@ DETECTORS: List[Callable[[Timeline, Any], List[Finding]]] = [
     detect_gateway_shedding,
     detect_cross_process_stall,
     detect_flywheel_staleness,
+    detect_replicated_giant,
     detect_incomplete_stream,
 ]
 

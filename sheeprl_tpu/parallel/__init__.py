@@ -1,3 +1,33 @@
-from .mesh import Distributed, Precision, build_distributed, get_precision
+from .mesh import (
+    Distributed,
+    Precision,
+    build_distributed,
+    get_precision,
+    maybe_shard_opt_state,
+    maybe_shard_params,
+)
+from .sharding import (
+    DEFAULT_PARAM_RULES,
+    ShardingReport,
+    SpecDecision,
+    SpecEngine,
+    SpecRule,
+    resolve_mesh_shape,
+    spec_str,
+)
 
-__all__ = ["Distributed", "Precision", "build_distributed", "get_precision"]
+__all__ = [
+    "Distributed",
+    "Precision",
+    "build_distributed",
+    "get_precision",
+    "maybe_shard_opt_state",
+    "maybe_shard_params",
+    "DEFAULT_PARAM_RULES",
+    "ShardingReport",
+    "SpecDecision",
+    "SpecEngine",
+    "SpecRule",
+    "resolve_mesh_shape",
+    "spec_str",
+]

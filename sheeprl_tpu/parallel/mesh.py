@@ -4,15 +4,27 @@ The reference wraps torch.distributed in Fabric (reference
 configs/fabric/default.yaml, cli.py:149-199): `launch` spawns one process per
 device, `setup_module` wraps modules in DDP, `backward` all-reduces grads over
 NCCL/Gloo. On TPU none of that exists as separate machinery: JAX is
-single-controller per host, and data parallelism is expressed as *sharding* —
-params replicated over a 1-D ``dp`` mesh, batches sharded on the leading axis,
-and XLA emits the psum for gradient averaging inside the jitted train step.
+single-controller per host, and parallelism is expressed as *sharding* over a
+named multi-axis `jax.sharding.Mesh`:
+
+* ``dp``   — data parallelism: batches sharded on the leading axis, params
+  replicated, XLA emits the psum for gradient averaging inside the jitted
+  train step;
+* ``fsdp`` — data parallelism with parameters/optimizer state ALSO sharded
+  (weight-update/ZeRO sharding, arXiv:2004.13336) so big world models fit;
+* ``tp``   — tensor parallelism: dense kernels split on a feature dimension.
+
+Axis sizes come from ``fabric.mesh.{dp,fsdp,tp}`` (one axis may be ``-1`` =
+auto-fill). Parameter placement is inferred per leaf by the rule engine in
+:mod:`sheeprl_tpu.parallel.sharding` — name rules + shape fallbacks, every
+decision recorded as a ``sharding`` telemetry event. The historical 1-D
+``dp`` layout is exactly the degenerate ``(dp=N, fsdp=1, tp=1)`` case.
 
 `Distributed` owns:
 * `jax.distributed.initialize` for multi-host (DCN) runs
-* the `jax.sharding.Mesh` (1-D ``dp`` for parity; extra axes reserved for
-  tp/sp extensions)
-* sharding helpers (`shard_batch`, `replicate`) and precision policy
+* the named `jax.sharding.Mesh` and the per-mesh :class:`SpecEngine`
+* sharding helpers (`shard_batch`, `shard_batch_axis`, `shard_params`,
+  `shard_opt_state`, `replicate`) and precision policy
 * seeding (`seed_everything` → a root `jax.random.key`)
 
 There is no "player vs trainer module" duality (reference ppo/agent.py:278-298
@@ -23,7 +35,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass
-from typing import Any, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +43,15 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
+from .sharding import (
+    DEFAULT_MIN_SHARD_SIZE,
+    MESH_AXES,
+    ShardingReport,
+    SpecEngine,
+    apply_specs,
+    infer_tree_specs,
+    resolve_mesh_shape,
+)
 
 _PRECISION_POLICIES = {
     # name: (param_dtype, compute_dtype). No fp16: it would need loss
@@ -84,8 +105,9 @@ class Distributed:
         precision: str = "32-true",
         num_nodes: int = 1,
         strategy: str = "auto",
-        mesh_axes: Sequence[str] = ("dp",),
+        mesh_axes: Optional[Sequence[str]] = None,
         mesh_shape: Optional[Sequence[int]] = None,
+        mesh: Optional[Any] = None,
     ):
         del strategy  # parity knob; sharding subsumes DDP/single-device
         # Multi-host initialization (DCN): driven by standard JAX env vars /
@@ -116,17 +138,71 @@ class Distributed:
         self.devices = all_devices[:n]
         self.num_nodes = num_nodes
 
-        axes = tuple(mesh_axes)
-        if mesh_shape is None:
-            mesh_shape = (n,) + (1,) * (len(axes) - 1)
+        def _mesh_get(key: str, default: Any) -> Any:
+            if mesh is None:
+                return default
+            if hasattr(mesh, "get"):
+                val = mesh.get(key, default)
+            else:
+                val = getattr(mesh, key, default)
+            return default if val is None else val
+
+        if mesh_axes is not None:
+            # legacy/compat 1-D construction (the pre-mesh-subsystem layout;
+            # kept for the bit-identity parity test and external callers)
+            axes = tuple(mesh_axes)
+            if mesh_shape is None:
+                mesh_shape = (n,) + (1,) * (len(axes) - 1)
+        else:
+            axes = MESH_AXES
+            mesh_shape = resolve_mesh_shape(
+                n,
+                dp=int(_mesh_get("dp", -1)),
+                fsdp=int(_mesh_get("fsdp", 1)),
+                tp=int(_mesh_get("tp", 1)),
+            )
         dev_array = np.asarray(self.devices).reshape(tuple(mesh_shape))
         self.mesh = Mesh(dev_array, axes)
+        self.axis_sizes: Dict[str, int] = {
+            ax: int(sz) for ax, sz in zip(self.mesh.axis_names, self.mesh.devices.shape)
+        }
+        self.spec_engine = SpecEngine(
+            self.axis_sizes,
+            min_shard_size=int(_mesh_get("min_shard_size", DEFAULT_MIN_SHARD_SIZE)),
+        )
+        # ShardingReports accumulated by shard_params/shard_opt_state until a
+        # train loop drains them into telemetry (take_sharding_reports)
+        self.sharding_reports: List[ShardingReport] = []
         self.precision = get_precision(precision)
 
     # -- identity ----------------------------------------------------------
     @property
     def world_size(self) -> int:
         return len(self.devices)
+
+    @property
+    def dp(self) -> int:
+        return self.axis_sizes.get("dp", 1)
+
+    @property
+    def fsdp(self) -> int:
+        return self.axis_sizes.get("fsdp", 1)
+
+    @property
+    def tp(self) -> int:
+        return self.axis_sizes.get("tp", 1)
+
+    @property
+    def data_parallel_size(self) -> int:
+        """How many ways a batch's leading axis shards: dp × fsdp (fsdp is
+        data parallelism too; tp replicas see the same batch). Equals
+        ``world_size`` on every non-tp mesh — batch-size math that used
+        world_size keeps its meaning in the degenerate case."""
+        return self.dp * self.fsdp
+
+    @property
+    def is_pure_dp(self) -> bool:
+        return self.fsdp == 1 and self.tp == 1
 
     @property
     def process_index(self) -> int:
@@ -150,8 +226,18 @@ class Distributed:
 
     @property
     def batch_sharding(self) -> NamedSharding:
-        """Leading-axis sharding over the dp axis — the DP data layout."""
-        return self.sharding("dp")
+        """Leading-axis sharding over the data axes (dp, and fsdp when the
+        mesh has one) — the batch layout of every train loop."""
+        return self.shard_batch_axis(0)
+
+    def shard_batch_axis(self, batch_axis: int) -> NamedSharding:
+        """Sharding for a batch whose batch dimension sits at ``batch_axis``
+        (e.g. 2 for the ``[G, T, B, ...]`` replay batches): the batch dim
+        shards over the engine's data axes, everything else replicates.
+        This is the ONLY way call sites outside ``parallel/`` place batches
+        — specs come from the rule engine, not axis-name literals (the
+        ``pspec-literal`` lint rule)."""
+        return NamedSharding(self.mesh, self.spec_engine.batch_spec(batch_axis))
 
     def shard_batch(self, tree: Any) -> Any:
         """Move a host batch to devices, sharded on the leading axis."""
@@ -162,34 +248,47 @@ class Distributed:
         s = self.replicated
         return jax.tree.map(lambda x: jax.device_put(x, s), tree)
 
-    def shard_over_dp(self, tree: Any, min_size: int = 2**14) -> Any:
-        """ZeRO-1-style placement for optimizer state (cf. "Automatic
-        Cross-Replica Sharding of Weight Update in Data-Parallel Training",
-        arXiv:2004.13336): shard each leaf's leading axis over `dp` when it
-        divides evenly and the leaf is big enough to be worth it; replicate
-        the rest. Inside the jitted train step XLA then computes the
-        moment/EMA updates 1/N-sharded (1/N memory and FLOPs) and inserts the
-        all-gather for the parameter delta — the standard DP weight-update
-        sharding trade. Gated by ``fabric.shard_optimizer_state``.
+    def shard_params(self, tree: Any, group: str = "params") -> Any:
+        """Rule-engine placement for a parameter tree: regex path rules pick
+        tp/fsdp layouts per dense-kernel role, shape fallbacks shard big
+        leaves over fsdp, small/odd leaves replicate. Every decision lands
+        in a :class:`ShardingReport` (drained into ``sharding`` telemetry
+        events by the train loop)."""
+        specs, report = infer_tree_specs(self.spec_engine, tree, group=group)
+        self.sharding_reports.append(report)
+        return apply_specs(self.mesh, tree, specs)
+
+    def shard_opt_state(self, tree: Any, min_size: int = DEFAULT_MIN_SHARD_SIZE) -> Any:
+        """Optimizer-state placement: moments mirror the param tree's names,
+        so sharded params keep matching specs; leaves the rules leave
+        replicated fall back to the leading-axis ZeRO-1 layout over the
+        fsdp axis (or dp on a pure-dp mesh — the historical
+        ``shard_over_dp`` placement, arXiv:2004.13336). Inside the jitted
+        train step XLA then computes the moment/EMA updates 1/N-sharded and
+        inserts the all-gather for the parameter delta.
 
         Multi-host runs shard too: checkpointing assembles non-addressable
         shards with a process_allgather collective on every rank
         (utils/checkpoint.py _fetch_global / CheckpointManager.save)."""
-        n = self.world_size
-        rep = self.replicated
+        specs, report = infer_tree_specs(
+            self.spec_engine, tree, group="opt_state", zero1_fallback=True, zero1_min_size=min_size
+        )
+        self.sharding_reports.append(report)
+        return apply_specs(self.mesh, tree, specs)
 
-        def place(x: Any) -> Any:
-            arr = np.asarray(x) if not isinstance(x, jax.Array) else x
-            if (
-                n > 1
-                and getattr(arr, "ndim", 0) >= 1
-                and arr.shape[0] % n == 0
-                and arr.size >= min_size
-            ):
-                return jax.device_put(x, self.sharding("dp", *([None] * (arr.ndim - 1))))
-            return jax.device_put(x, rep)
+    def shard_over_dp(self, tree: Any, min_size: int = DEFAULT_MIN_SHARD_SIZE) -> Any:
+        """Compat shim for the pre-mesh-subsystem API: delegates to the rule
+        engine's ZeRO-1 optimizer layout. Under ``(dp=N, fsdp=1, tp=1)``
+        every placement is identical to the historical implementation
+        (leading axis over ``dp`` when it divides and the leaf is big
+        enough, replicated otherwise) — asserted by tests/test_mesh_sharding.py."""
+        return self.shard_opt_state(tree, min_size=min_size)
 
-        return jax.tree.map(place, tree)
+    def take_sharding_reports(self) -> List[ShardingReport]:
+        """Drain the accumulated reports (train loops emit them as
+        ``sharding`` telemetry events once the Telemetry facade exists)."""
+        out, self.sharding_reports = self.sharding_reports, []
+        return out
 
     def to_host(self, tree: Any) -> Any:
         return jax.device_get(tree)
@@ -232,13 +331,32 @@ def build_distributed(cfg: Config) -> Distributed:
         precision=str(fab.get("precision", "32-true")),
         num_nodes=int(fab.get("num_nodes", 1)),
         strategy=fab.get("strategy", "auto"),
+        mesh=fab.get("mesh", None),
     )
 
 
 def maybe_shard_opt_state(cfg: Any, dist: Optional["Distributed"], opt_states: Any) -> Any:
-    """ZeRO-1-style layout when ``fabric.shard_optimizer_state``: optimizer
-    moments sharded over `dp` (Distributed.shard_over_dp) so the weight
-    update runs 1/N-sharded. Applied once, to fresh AND resumed state."""
-    if dist is not None and cfg.select("fabric.shard_optimizer_state", False):
+    """Optimizer-state layout: on a multi-axis mesh (fsdp or tp > 1) the
+    state always follows the rule engine — moments mirror their params'
+    inferred specs, replicated leaves get the ZeRO-1 fallback. On a pure-dp
+    mesh the historical behavior is preserved: sharded over ``dp`` only when
+    ``fabric.shard_optimizer_state`` asks for it. Applied once, to fresh AND
+    resumed state."""
+    if dist is None:
+        return opt_states
+    if not dist.is_pure_dp:
+        return dist.shard_opt_state(opt_states)
+    if cfg.select("fabric.shard_optimizer_state", False):
         return dist.shard_over_dp(opt_states)
     return opt_states
+
+
+def maybe_shard_params(cfg: Any, dist: Optional["Distributed"], params: Any) -> Any:
+    """Parameter layout: a strict no-op on pure-dp meshes (params stay
+    wherever the builder left them — replication is implicit, and the 1-D
+    path must remain bit-identical); on a multi-axis mesh every leaf goes
+    through the rule engine and is committed to its inferred NamedSharding."""
+    del cfg
+    if dist is None or dist.is_pure_dp:
+        return params
+    return dist.shard_params(params)

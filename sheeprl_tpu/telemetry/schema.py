@@ -373,6 +373,32 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "detail": (False, _STR),
         "t": (False, _NUM),
     },
+    # one partition-spec inference decision (sheeprl_tpu/parallel/sharding.py
+    # SpecEngine): `action` is "leaf" — one parameter/optimizer-state leaf's
+    # inferred PartitionSpec, the rule that produced it, the reason chain
+    # (divisibility fallbacks included) and its bytes/bytes-per-chip — or
+    # "summary", the per-tree totals (`bytes_per_chip` is the number the
+    # MULTICHIP bench gates; `replicated_bytes` is what doctor's
+    # `replicated_giant` hunts oversized leaves in). dp/fsdp/tp are the mesh
+    # axis sizes the decisions were made against.
+    "sharding": {
+        "action": (True, _STR),  # leaf | summary
+        "group": (False, _STR),  # params | opt_state
+        "path": (False, _STR),
+        "shape": (False, list),
+        "spec": (False, _STR),
+        "rule": (False, _STR),
+        "reason": (False, _STR),
+        "bytes": (False, _NUM),
+        "bytes_per_chip": (False, _NUM),
+        "dp": (False, _NUM),
+        "fsdp": (False, _NUM),
+        "tp": (False, _NUM),
+        "leaves": (False, _NUM),
+        "replicated_leaves": (False, _NUM),
+        "total_bytes": (False, _NUM),
+        "replicated_bytes": (False, _NUM),
+    },
     # deterministic fault injection (resilience/chaos.py): faults the
     # SUPERVISOR injects (worker-side faults surface as `fleet` incidents —
     # a chaos crash is indistinguishable from a real one by design)

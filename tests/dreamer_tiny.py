@@ -34,17 +34,20 @@ TINY_DV3 = [
 N_ACT = 4
 
 
-def make_trainer(overrides=()):
+def make_trainer(overrides=(), devices=1, mesh=None, return_dist=False):
     """Tiny agent + optimizers + jitted train fn from TINY_DV3 + overrides.
-    Returns (train, params, opt_states, moments)."""
+    Returns (train, params, opt_states, moments) — plus the Distributed
+    when ``return_dist`` (the mesh-sharding tests need the spec engine)."""
     cfg = compose("config", TINY_DV3 + list(overrides))
-    dist = Distributed(devices=1)
+    dist = Distributed(devices=devices, mesh=mesh)
     obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8)})
     wm, actor, critic, params = build_agent(
         dist, cfg, obs_space, [N_ACT], False, jax.random.key(0)
     )
     txs, opt_states = build_optimizers(cfg, params)
     train = make_train_fn(wm, actor, critic, txs, cfg, False, [N_ACT])
+    if return_dist:
+        return train, params, opt_states, init_moments(), dist
     return train, params, opt_states, init_moments()
 
 

@@ -18,6 +18,7 @@ from sheeprl_tpu.analysis.engine import main as lint_main
 from sheeprl_tpu.analysis.rules.donation import UseAfterDonateRule
 from sheeprl_tpu.analysis.rules.host_sync import HostSyncRule
 from sheeprl_tpu.analysis.rules.retrace import RetraceHazardRule
+from sheeprl_tpu.analysis.rules.pspec import PspecLiteralRule
 from sheeprl_tpu.analysis.rules.rng import RngReuseRule
 from sheeprl_tpu.analysis.rules.sockets import SocketTimeoutRule
 from sheeprl_tpu.analysis.rules.telemetry_schema import TelemetrySchemaRule
@@ -454,6 +455,72 @@ def test_sockets_rule_scoped_to_transport_subsystems(tmp_path):
     assert findings == []
 
 
+# ------------------------------------------------------- pspec-literal
+PSPEC_RED = """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def stage(dist, batch, mesh):
+        spec = P(None, "dp")
+        sh = NamedSharding(mesh, spec)
+        mb = dist.sharding(None, None, "dp")
+        grads = jax.lax.psum(batch, axis_name="tp")
+        return jax.device_put(batch, mb)
+"""
+
+
+def test_pspec_red(tmp_path):
+    findings, f = _lint(tmp_path, PSPEC_RED, PspecLiteralRule(), name="algos/red.py")
+    assert all(x.rule_id == "pspec-literal" for x in findings)
+    lines = [x.line for x in findings]
+    # P(...) ctor (its own 'dp' literal is covered by the ctor finding),
+    # NamedSharding ctor, the .sharding("dp") literal, the axis_name= kwarg
+    assert 6 in lines and 7 in lines and 8 in lines and 9 in lines
+    by_line = {x.line: x for x in findings}
+    assert "PartitionSpec" in by_line[6].message
+    assert "NamedSharding" in by_line[7].message
+    assert "'dp'" in by_line[8].message and "sharding" in by_line[8].message
+    assert "psum" in by_line[9].message and "'tp'" in by_line[9].message
+
+
+def test_pspec_green_engine_helpers_and_suppression(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        import jax
+
+        def stage(dist, batch, g):
+            mb = dist.shard_batch_axis(2)          # specs come from the engine
+            params = dist.shard_params({"k": batch})
+            cfg = {"dp": 2}                         # plain dict keys are data
+            name = "dp" if g else "tp"              # bare literals outside calls too
+            legacy = dist.sharding(None, "dp")  # lint: ok[pspec-literal] parity-test leg
+            return jax.device_put(batch, mb)
+        """,
+        PspecLiteralRule(),
+    )
+    assert findings == []
+
+
+def test_pspec_rule_skips_the_parallel_subsystem(tmp_path):
+    # the engine itself is the one legitimate home of specs and axis names
+    findings, _ = _lint(tmp_path, PSPEC_RED, PspecLiteralRule(), name="parallel/sharding.py")
+    assert findings == []
+
+
+def test_pspec_tuple_axis_literals_flagged(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        def stage(dist):
+            return dist.sharding(None, ("dp", "fsdp"))
+        """,
+        PspecLiteralRule(),
+    )
+    assert len(findings) == 2  # one per axis literal inside the tuple
+    assert {"'dp'" in f.message or "'fsdp'" in f.message for f in findings} == {True}
+
+
 # ------------------------------------------------- telemetry-schema-drift
 FAKE_SCHEMA = {
     "demo": {"step": (True, int), "detail": (False, str)},
@@ -722,6 +789,7 @@ RED_BY_RULE = {
     ),
     "thread-shared-state": ("engine/snippet.py", THREADS_RED, 14),
     "socket-timeout": ("fleet/snippet.py", SOCKETS_RED, 8),
+    "pspec-literal": ("algos/snippet.py", PSPEC_RED, 6),
     "telemetry-schema-drift": (
         "snippet.py",
         """

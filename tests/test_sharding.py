@@ -1,0 +1,394 @@
+"""Multi-axis mesh (dp × fsdp × tp) + partition-spec inference
+(sheeprl_tpu/parallel/sharding.py).
+
+Covers the ISSUE 15 acceptance surface:
+* mesh resolution (auto ``-1`` fill, mis-sized shapes rejected);
+* golden-file pin of every inferred spec + per-chip bytes over the real
+  (tiny) DreamerV3 param tree on a 2×2×2 mesh;
+* divisibility fallbacks — odd shapes replicate, never crash;
+* the ZeRO-1 optimizer layout generalized to the fsdp axis;
+* a 2×2×2 CPU train smoke: finite losses, zero retraces after warmup,
+  per-chip param bytes strictly below the replicated baseline;
+* 512-step SAC bit-identity: the new ``(dp=N, fsdp=1, tp=1)`` mesh vs the
+  legacy 1-D dp mesh (the pre-subsystem "current path");
+* doctor ``replicated_giant`` red/green over `sharding` telemetry events;
+* bench_compare's MULTICHIP per-chip gates (regression flagged, pre-
+  sharding rounds auto-skipped).
+"""
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel import Distributed, resolve_mesh_shape, spec_str
+from sheeprl_tpu.parallel.sharding import SpecEngine, infer_tree_specs
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tests"))
+GOLDEN = REPO / "tests" / "test_data" / "golden_sharding_dv3_2x2x2.json"
+
+
+# ---------------------------------------------------------------- mesh shape
+def test_resolve_mesh_shape_autofill():
+    assert resolve_mesh_shape(8) == (8, 1, 1)
+    assert resolve_mesh_shape(8, dp=-1, fsdp=2) == (4, 2, 1)
+    assert resolve_mesh_shape(8, dp=2, fsdp=-1, tp=2) == (2, 2, 2)
+    assert resolve_mesh_shape(8, dp=1, fsdp=1, tp=8) == (1, 1, 8)
+
+
+def test_resolve_mesh_shape_rejects_bad_shapes():
+    with pytest.raises(ValueError, match="at most one axis"):
+        resolve_mesh_shape(8, dp=-1, fsdp=-1)
+    with pytest.raises(ValueError, match="not divisible"):
+        resolve_mesh_shape(8, dp=-1, fsdp=3)
+    with pytest.raises(ValueError, match="dp\\*fsdp\\*tp"):
+        resolve_mesh_shape(8, dp=2, fsdp=2, tp=1)
+    with pytest.raises(ValueError, match="must be >= 1 or -1"):
+        resolve_mesh_shape(8, dp=0)
+
+
+def test_degenerate_mesh_is_the_historical_1d_layout():
+    """(dp=N, fsdp=1, tp=1): batch specs normalize to the exact 1-D
+    placements and every param spec comes out fully replicated."""
+    d = Distributed(devices=8)
+    assert d.axis_sizes == {"dp": 8, "fsdp": 1, "tp": 1}
+    assert d.is_pure_dp and d.data_parallel_size == 8
+    legacy = Distributed(devices=8, mesh_axes=("dp",))
+    assert d.shard_batch_axis(2).spec == legacy.sharding(None, None, "dp").spec
+    assert d.batch_sharding.spec == legacy.sharding("dp").spec
+    # params: nothing to shard without an fsdp/tp axis
+    specs, rep = infer_tree_specs(d.spec_engine, {"dense_0": {"kernel": jnp.ones((256, 512))}})
+    assert rep.decisions[0].replicated
+    assert rep.bytes_per_chip == rep.total_bytes
+
+
+# ---------------------------------------------------------------- golden pin
+def test_golden_specs_over_dreamer_v3_param_tree():
+    """Every leaf of the real (tiny) DreamerV3 tree: spec, rule and
+    per-chip bytes pinned on the 2×2×2 mesh. A diff here is a layout
+    change — regenerate deliberately, never incidentally."""
+    from dreamer_tiny import make_trainer
+
+    golden = json.loads(GOLDEN.read_text())
+    train, params, opt_states, moments, dist = make_trainer(
+        devices=8, mesh={"dp": 2, "fsdp": 2, "tp": 2}, return_dist=True
+    )
+    specs, rep = infer_tree_specs(dist.spec_engine, params)
+    got = {
+        d.path: {
+            "shape": list(d.shape),
+            "spec": spec_str(d.spec),
+            "rule": d.rule,
+            "bytes_per_chip": d.bytes_per_chip(rep.axis_sizes),
+        }
+        for d in rep.decisions
+    }
+    assert got == golden["leaves"]
+    assert rep.summary() == golden["summary"]
+    # the point of the subsystem: each chip holds strictly less than the
+    # replicated baseline, and dense kernels actually tp-shard
+    assert rep.bytes_per_chip < rep.total_bytes
+    assert any(spec_str(d.spec) == "(None, tp)" for d in rep.decisions)
+    assert any(spec_str(d.spec) == "(tp, None)" for d in rep.decisions)
+
+
+# ------------------------------------------------------- divisibility rules
+def test_odd_shapes_replicate_never_crash():
+    eng = SpecEngine({"dp": 2, "fsdp": 2, "tp": 2}, min_shard_size=64)
+    # tp wants the last dim of a dense kernel; 255 is odd → falls through
+    # fsdp (dim 0 divides) instead of crashing
+    d = eng.infer("mlp/dense_0/kernel", (128, 255))
+    assert spec_str(d.spec) == "(fsdp, None)"
+    assert "does not divide" in d.reason
+    # nothing divides → fully replicated
+    d = eng.infer("mlp/dense_0/kernel", (127, 255))
+    assert d.replicated and "does not divide" in d.reason
+    # 1-D / scalar leaves replicate via the shape fallback
+    assert eng.infer("bias_like", (1023,)).replicated
+    assert eng.infer("scalar", ()).replicated
+    # big unmatched 2-D leaf → fsdp on its biggest divisible axis
+    d = eng.infer("some/unknown_table", (4096, 33))
+    assert spec_str(d.spec) == "(fsdp, None)" and d.rule == "shape-fallback"
+
+
+def test_small_leaves_stay_replicated_under_min_shard_size():
+    eng = SpecEngine({"dp": 2, "fsdp": 4, "tp": 1}, min_shard_size=2**14)
+    d = eng.infer("tiny/unknown", (16, 16))
+    assert d.replicated and "min_shard_size" in d.reason
+
+
+# ------------------------------------------------ ZeRO-1 opt-state layout
+def test_zero1_generalizes_to_fsdp_axis():
+    d = Distributed(devices=8, mesh={"dp": 2, "fsdp": 4, "tp": 1})
+    placed = d.shard_over_dp(
+        # "m" shards via the 2-D shape fallback; "v" is 1-D (rule-replicated)
+        # so only the ZeRO-1 leading-axis fallback can place it
+        {"m": jnp.ones((1024, 64)), "v": jnp.ones((65536,)), "small": jnp.ones((4, 4))}
+    )
+    assert placed["m"].sharding.spec[0] == "fsdp"  # not dp: the fsdp axis owns state
+    assert placed["v"].sharding.spec == ("fsdp",)
+    assert placed["small"].sharding.is_fully_replicated
+    rep = d.take_sharding_reports()[-1]
+    assert rep.group == "opt_state"
+    assert any(dec.rule == "zero1" and not dec.replicated for dec in rep.decisions)
+
+
+def test_opt_state_follows_sharded_param_specs():
+    """Optimizer moments mirror the param tree's names, so a tp-sharded
+    kernel's moments land tp-sharded too (not leading-axis zero1)."""
+    d = Distributed(devices=8, mesh={"dp": 2, "fsdp": 2, "tp": 2})
+    tree = {"mu": {"dense_0": {"kernel": jnp.ones((128, 256))}}}
+    placed = d.shard_opt_state(tree)
+    assert placed["mu"]["dense_0"]["kernel"].sharding.spec == d.shard_params(
+        {"dense_0": {"kernel": jnp.ones((128, 256))}}
+    )["dense_0"]["kernel"].sharding.spec
+
+
+def test_shard_over_dp_compat_is_bit_compatible_with_legacy():
+    """The compat shim under (N,1,1) reproduces the historical placements
+    AND the historical values (layout only, never math)."""
+    d = Distributed(devices=8)
+    legacy = Distributed(devices=8, mesh_axes=("dp",))
+    tree = {"big": jnp.arange(1024 * 64, dtype=jnp.float32).reshape(1024, 64)}
+    new = d.shard_over_dp(tree)["big"]
+    old = jax.device_put(tree["big"], legacy.sharding("dp", None))
+    assert new.sharding.spec == old.sharding.spec
+    np.testing.assert_array_equal(np.asarray(new), np.asarray(old))
+
+
+# ---------------------------------------------------- 2×2×2 CPU train smoke
+def test_dreamer_2x2x2_mesh_train_smoke():
+    """Full DreamerV3 train bursts on the 2×2×2 virtual-CPU mesh: finite
+    losses, ZERO retraces after the output-sharding warmup, and per-chip
+    param+opt bytes strictly below the replicated baseline."""
+    from dreamer_tiny import N_ACT, make_trainer
+
+    train, params, opt_states, moments, dist = make_trainer(
+        # shorter scan/imagination than the shared tiny config: this test
+        # compiles the program three times (the sharding fixed point), so
+        # program size is the wall-clock knob
+        overrides=["algo.horizon=2", "algo.per_rank_sequence_length=2"],
+        devices=8,
+        mesh={"dp": 2, "fsdp": 2, "tp": 2},
+        return_dist=True,
+    )
+    params = dist.shard_params(params)
+    opt_states = dist.shard_opt_state(opt_states)
+    reports = {r.group: r for r in dist.take_sharding_reports()}
+    for rep in reports.values():
+        assert rep.bytes_per_chip < rep.total_bytes, rep.summary()
+
+    rng = np.random.default_rng(0)
+    T, B = 2, 2 * dist.data_parallel_size
+    sh = dist.shard_batch_axis(2)
+
+    def batch():
+        return {
+            "rgb": jax.device_put(rng.integers(0, 255, (1, T, B, 64, 64, 3)).astype(np.uint8), sh),
+            "actions": jax.device_put(
+                np.eye(N_ACT, dtype=np.float32)[rng.integers(0, N_ACT, (1, T, B))], sh
+            ),
+            "rewards": jax.device_put(rng.standard_normal((1, T, B, 1)).astype(np.float32), sh),
+            "terminated": jax.device_put(np.zeros((1, T, B, 1), np.float32), sh),
+            "truncated": jax.device_put(np.zeros((1, T, B, 1), np.float32), sh),
+            "is_first": jax.device_put(np.zeros((1, T, B, 1), np.float32), sh),
+        }
+
+    metrics = None
+    warmup = 3  # the GSPMD output-sharding fixed point lands within 3 calls
+    for i in range(warmup):
+        params, opt_states, moments, metrics = train(
+            params, opt_states, moments, batch(), jax.random.split(jax.random.key(i), 1)
+        )
+    cache_after_warmup = train._cache_size()
+    params, opt_states, moments, metrics = train(
+        params, opt_states, moments, batch(), jax.random.split(jax.random.key(10), 1)
+    )
+    assert train._cache_size() == cache_after_warmup, "retrace after warmup"
+    for k, v in metrics.items():
+        assert np.isfinite(np.asarray(v)).all(), k
+    # params kept their inferred layout through the donated train step
+    flat = jax.tree.leaves(params)
+    assert any(not leaf.sharding.is_fully_replicated for leaf in flat)
+
+
+# ------------------------------------------- 512-step SAC bit-identity
+def _sac_args(run_name, total=512):
+    return [
+        "exp=sac",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "metric.log_level=0",
+        f"algo.total_steps={total}",
+        "algo.learning_starts=16",
+        "algo.per_rank_batch_size=4",
+        "algo.hidden_size=8",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.run_test=False",
+        "algo.overlap.enabled=False",
+        "buffer.size=512",
+        "buffer.memmap=False",
+        "checkpoint.every=0",
+        "checkpoint.save_last=True",
+        "model_manager.disabled=True",
+        "fabric.devices=2",
+        "seed=3",
+        f"run_name={run_name}",
+    ]
+
+
+def _final_ckpt(run_name):
+    from sheeprl_tpu.utils.checkpoint import CheckpointManager
+
+    base = Path("logs/runs/sac/continuous_dummy") / run_name
+    cks = sorted(
+        (base / "version_0" / "checkpoint").glob("ckpt_*.ckpt"),
+        key=lambda p: int(p.stem.split("_")[1]),
+    )
+    assert cks, f"no checkpoint under {base}"
+    return CheckpointManager.load(cks[-1])
+
+
+def test_sac_512_step_parity_degenerate_mesh_vs_legacy_1d(monkeypatch):
+    """ISSUE 15 acceptance: training on the new (dp=2, fsdp=1, tp=1) mesh
+    is BIT-IDENTICAL to the legacy 1-D dp mesh over 512 SAC steps — same
+    params, same optimizer state, same ratio ledger."""
+    import sheeprl_tpu.cli as cli
+    from sheeprl_tpu.config import Config
+
+    run = cli.run
+
+    run(_sac_args("mesh_parity_new"))
+    new = _final_ckpt("mesh_parity_new")
+
+    real_build = cli.build_distributed
+
+    def legacy_build(cfg):
+        fab = cfg.get("fabric", Config())
+        return Distributed(
+            devices=fab.get("devices", 1),
+            precision=str(fab.get("precision", "32-true")),
+            mesh_axes=("dp",),  # lint: ok[pspec-literal] the legacy 1-D parity leg IS the point
+        )
+
+    monkeypatch.setattr(cli, "build_distributed", legacy_build)
+    run(_sac_args("mesh_parity_legacy"))
+    monkeypatch.setattr(cli, "build_distributed", real_build)
+    old = _final_ckpt("mesh_parity_legacy")
+
+    assert new["policy_step"] == old["policy_step"] == 512
+    assert new["ratio"] == old["ratio"]
+    new_leaves = jax.tree.leaves(new["params"])
+    old_leaves = jax.tree.leaves(old["params"])
+    assert len(new_leaves) == len(old_leaves) > 0
+    for a, b in zip(new_leaves, old_leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(new["opt_states"]), jax.tree.leaves(old["opt_states"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------- doctor replicated_giant
+def _sharding_leaf(path, nbytes, spec="replicated", fsdp=2, tp=2, rule="shape-fallback", reason="x"):
+    return {
+        "event": "sharding",
+        "action": "leaf",
+        "group": "params",
+        "path": path,
+        "shape": [nbytes // 4],
+        "spec": spec,
+        "rule": rule,
+        "reason": reason,
+        "bytes": nbytes,
+        "bytes_per_chip": nbytes,
+        "dp": 2,
+        "fsdp": fsdp,
+        "tp": tp,
+    }
+
+
+def test_replicated_giant_red_green():
+    from sheeprl_tpu.diag.findings import detect_replicated_giant
+    from sheeprl_tpu.diag.timeline import Timeline
+
+    # red: a 100 MiB leaf replicated on a multi-axis mesh
+    tl = Timeline(
+        [
+            _sharding_leaf("wm/encoder/huge/kernel", 100 * 2**20, reason="no dim divisible by fsdp=2"),
+            _sharding_leaf("wm/tiny/bias", 128),
+        ]
+    )
+    findings = detect_replicated_giant(tl)
+    assert len(findings) == 1 and findings[0].code == "replicated_giant"
+    assert "wm/encoder/huge/kernel" in findings[0].detail
+    assert "shape-fallback" in findings[0].detail  # the nearest matching rule is named
+
+    # green 1: same leaf but actually sharded
+    tl = Timeline([_sharding_leaf("wm/encoder/huge/kernel", 100 * 2**20, spec="(fsdp, None)")])
+    assert detect_replicated_giant(tl) == []
+    # green 2: replicated giant on a PURE-DP mesh — nothing could shard it
+    tl = Timeline([_sharding_leaf("wm/encoder/huge/kernel", 100 * 2**20, fsdp=1, tp=1)])
+    assert detect_replicated_giant(tl) == []
+    # green 3: under the threshold
+    cfg = {"diag": {"sharding": {"max_replicated_bytes": 256 * 2**20}}}
+    tl = Timeline([_sharding_leaf("wm/encoder/huge/kernel", 100 * 2**20)])
+    assert detect_replicated_giant(tl, cfg) == []
+
+
+# ------------------------------------------- bench_compare per-chip gates
+def _mc(round_no, ok=True, **extra):
+    rec = {"n_devices": 8, "ok": ok, "skipped": False, "_round": round_no, "_file": f"MULTICHIP_r{round_no:02d}.json"}
+    rec.update(extra)
+    return rec
+
+
+def test_bench_compare_multichip_per_chip_gates():
+    sys.path.insert(0, str(REPO / "scripts"))
+    import bench_compare
+
+    unit = "dv3 replayed frames/s (n=8 dp2xfsdp2xtp2)"
+    prior = _mc(6, unit=unit, platform="cpu", per_chip_sps=10.0, per_chip_mfu=1e-3, param_bytes_per_chip=1000)
+    # regression: SPS down 40%, param bytes UP 2x
+    bad = _mc(7, unit=unit, platform="cpu", per_chip_sps=6.0, per_chip_mfu=1e-3, param_bytes_per_chip=2500)
+    report = bench_compare.compare([], multichip=[prior, bad])
+    assert not report["ok"]
+    kinds = " ".join(report["failures"])
+    assert "per-chip SPS" in kinds and "param bytes per chip" in kinds
+
+    # healthy round passes
+    good = _mc(7, unit=unit, platform="cpu", per_chip_sps=10.5, per_chip_mfu=1.1e-3, param_bytes_per_chip=990)
+    assert bench_compare.compare([], multichip=[prior, good])["ok"]
+
+    # auto-skip: newest carries the fields, priors are correctness-only
+    legacy = _mc(5)  # pre-sharding round: ok/tail only
+    report = bench_compare.compare([], multichip=[legacy, good])
+    assert report["ok"]
+    verdicts = {c["metric"]: c["verdict"] for c in report["comparisons"]}
+    assert verdicts["per_chip_sps [multichip]"].startswith("skipped")
+
+    # the ok→fail flip check still guards the whole trajectory
+    report = bench_compare.compare([], multichip=[prior, _mc(7, ok=False, unit=unit, platform="cpu")])
+    assert not report["ok"]
+
+
+def test_recorded_multichip_r06_round_is_gated():
+    """The repo's actual trajectory (incl. the recorded r06 per-chip round)
+    must pass the gate — and r06 must really carry the per-chip fields."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    import bench_compare
+
+    multichip = bench_compare.load_multichip(REPO)
+    newest = multichip[-1]
+    assert newest.get("per_chip_sps") and newest.get("param_bytes_per_chip")
+    assert newest["param_bytes_per_chip"] < newest["replicated_param_bytes"]
+    assert newest.get("retraces_after_warmup") == 0
+    report = bench_compare.compare([], multichip=multichip)
+    assert report["ok"], report["failures"]
