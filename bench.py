@@ -126,6 +126,19 @@ def _timed_cli_run(
         rec["startup_seconds"] = round(steady_t - t0, 2)  # env init + compile + first burst
     if steps_done < steps:
         rec["wall_capped"] = True
+    # continuous binding-stage attribution (diag/aggregator.py): the
+    # offline trace verdict over the leg's own telemetry streams, stamped
+    # onto the record. Informational — bench_compare never gates on it.
+    leg_log_dir = run_info.last_run.get("log_dir")
+    if leg_log_dir:
+        try:
+            from sheeprl_tpu.diag.aggregator import binding_stage_for_run
+
+            stage = binding_stage_for_run(leg_log_dir)
+            if stage:
+                rec["binding_stage"] = stage
+        except Exception:
+            pass
     try:
         # same basis stamp as bench_dv3.record(): the e2e record labels its
         # own MFU denominator class (vendor peak vs measured host matmul)

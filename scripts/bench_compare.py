@@ -359,6 +359,8 @@ def compare(
             "unit": newest.get("unit"),
             "wall_capped": newest.get("wall_capped"),
             "preflight_attempts": newest.get("preflight_attempts"),
+            # informational only — attribution context, never a gate
+            "binding_stage": newest.get("binding_stage"),
         }
         if not priors:
             report["note"] = (
@@ -387,6 +389,7 @@ def compare(
                 "file": newest_s["_file"],
                 "unit": newest_s.get("unit"),
                 "platform_class": platform_class(newest_s),
+                "binding_stage": newest_s.get("binding_stage"),
             }
             _gate_fields(
                 report,
@@ -444,6 +447,7 @@ def compare(
                 "file": newest_f["_file"],
                 "unit": newest_f.get("unit"),
                 "platform_class": platform_class(newest_f),
+                "binding_stage": newest_f.get("binding_stage"),
             }
             _gate_fields(
                 report,

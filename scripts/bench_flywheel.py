@@ -395,6 +395,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         "duration_s": round(float(baseline["duration_s"]) + float(captured_leg["duration_s"]), 1),
         "platform": "cpu",
     }
+    try:
+        # binding-stage attribution over the round's merged streams (the
+        # offline trace verdict), stamped on the record. Informational.
+        from sheeprl_tpu.diag.aggregator import binding_stage_for_run
+
+        stage = binding_stage_for_run(run_dir)
+        if stage:
+            record["binding_stage"] = stage
+    except Exception:
+        pass
     problems = validate_event(record)
     if problems:
         print(f"[bench_flywheel] SCHEMA-INVALID record: {problems}", file=sys.stderr)

@@ -673,6 +673,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             record["broker_recovery_s"] = broker_leg["recovery_s"]
         if broker_leg.get("repl_lag_p95_ms") is not None:
             record["broker_repl_lag_p95_ms"] = round(broker_leg["repl_lag_p95_ms"], 3)
+    if telemetry_dir is not None:
+        # binding-stage attribution over the bench's own merged streams
+        # (gateway + replicas): the same verdict `sheeprl_tpu trace` makes,
+        # stamped on the record. Informational — never gated.
+        try:
+            from sheeprl_tpu.diag.aggregator import binding_stage_for_run
+
+            stage = binding_stage_for_run(telemetry_dir)
+            if stage:
+                record["binding_stage"] = stage
+        except Exception:
+            pass
     problems = validate_event(record)
     if problems:
         print(f"[bench_serve] SCHEMA-INVALID record: {problems}", file=sys.stderr)

@@ -3,7 +3,8 @@
 # none of the checks can silently rot:
 #   * `sheeprl_tpu lint` — the JAX-aware static-analysis pass
 #     (sheeprl_tpu/analysis/): host-sync, retrace-hazard, rng-reuse,
-#     use-after-donate, thread-shared-state and telemetry-schema-drift rules
+#     use-after-donate, thread-shared-state, telemetry-schema-drift,
+#     socket-timeout, pspec-literal and hot-loop-emit rules
 #     over the whole package; exits 1 on any unsuppressed finding
 #     (suppression syntax + rule catalogue: howto/static_analysis.md);
 #   * scripts/check_host_sync.py — the compat shim over the host-sync rule,

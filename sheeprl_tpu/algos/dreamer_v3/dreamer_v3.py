@@ -582,7 +582,7 @@ def main(dist: Distributed, cfg: Config) -> None:
     # events — doctor's replicated_giant reads them
     for _rep in dist.take_sharding_reports():
         for _ev in _rep.events():
-            telem.emit(_ev)
+            telem.emit(_ev)  # lint: ok[hot-loop-emit] one-time setup loop (sharding reports), not the step loop
     ckpt = CheckpointManager(log_dir, keep_last=cfg.checkpoint.keep_last, enabled=rank == 0)
     guard = RunGuard.setup(cfg, ckpt, telem, log_dir)
     ckpt = guard.ckpt

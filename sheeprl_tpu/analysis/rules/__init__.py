@@ -9,6 +9,7 @@ from typing import List
 from ..engine import Rule
 from .donation import UseAfterDonateRule
 from .host_sync import HostSyncRule
+from .hot_loop import HotLoopEmitRule
 from .pspec import PspecLiteralRule
 from .retrace import RetraceHazardRule
 from .rng import RngReuseRule
@@ -25,6 +26,7 @@ RULE_CLASSES = [
     TelemetrySchemaRule,
     SocketTimeoutRule,
     PspecLiteralRule,
+    HotLoopEmitRule,
 ]
 
 

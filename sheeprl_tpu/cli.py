@@ -396,6 +396,23 @@ def trace(args: Optional[Sequence[str]] = None) -> None:
         raise SystemExit(rc)
 
 
+def top(args: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl_tpu top run_dir=<logs/runs/.../version_N> [refresh_s=2]
+    [once=true] [json=true]` — watch a run live (diag/live.py): renders the
+    LiveAggregator's windowed rollup table (per-role/per-stage p50/p95,
+    SPS/MFU, publish→apply lag, relay drop counters), the current binding
+    stage and any firing SLO burn alerts, refreshing in place. Polls the
+    run's `GET /live` endpoint (discovered via <log_dir>/live.json) while
+    the run is up; falls back to aggregating the run's merged streams
+    offline once it is gone."""
+    argv = list(args if args is not None else sys.argv[1:])
+    from .diag.live import main as top_main
+
+    rc = top_main(argv)
+    if rc:
+        raise SystemExit(rc)
+
+
 def lint(args: Optional[Sequence[str]] = None) -> None:
     """`sheeprl_tpu lint [paths...] [--json] [--rule r1,r2] [--list-rules]` —
     the JAX-aware static-analysis pass (analysis/): host-sync, retrace-hazard,
@@ -474,11 +491,11 @@ def available_agents() -> None:
 
 
 def main() -> None:
-    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|gateway|brokerd|flywheel|doctor|trace|lint|registration|agents> ...`"""
+    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|gateway|brokerd|flywheel|doctor|trace|top|lint|registration|agents> ...`"""
     argv = sys.argv[1:]
     if argv and argv[0] in (
         "run", "eval", "evaluation", "resume", "serve", "gateway", "brokerd", "flywheel",
-        "doctor", "trace", "lint", "registration", "agents",
+        "doctor", "trace", "top", "lint", "registration", "agents",
     ):
         cmd, rest = argv[0], argv[1:]
     else:
@@ -501,6 +518,8 @@ def main() -> None:
         doctor(rest)
     elif cmd == "trace":
         trace(rest)
+    elif cmd == "top":
+        top(rest)
     elif cmd == "lint":
         lint(rest)
     elif cmd == "registration":

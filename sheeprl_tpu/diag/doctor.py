@@ -103,6 +103,7 @@ def diagnose(
             cfg = load_config_file(log_dir / "config.yaml")
         except Exception:
             cfg = None
+    run_cfg = cfg  # the roster check needs the RUN config, not diag defaults
     cfg = _load_diag_cfg(cfg)
     stream = log_dir / "telemetry.jsonl"
     segments = rotated_segments(stream)
@@ -131,6 +132,29 @@ def diagnose(
             # but it must not vanish silently either
             tl.parse_errors.append(f"{name}: stream unreadable ({err})")
     findings = run_detectors(tl, cfg)
+
+    # roster check: streams the run config promises but the run dir lacks —
+    # a worker/replica that died before its first write, or telemetry
+    # silently misconfigured, must not read as "the run looks fine"
+    from .trace import missing_streams
+
+    miss = missing_streams(run_cfg, ["main"] + process_streams)
+    if miss:
+        findings.append(
+            Finding(
+                code="missing_stream",
+                severity="warning",
+                title=f"{len(miss)} expected telemetry stream(s) never appeared",
+                detail="; ".join(f"{m['stream']} ({m['why']})" for m in miss),
+                remediation=(
+                    "Check the process's stderr/exit status — a stream that never "
+                    "opened usually means the process died before its first write. "
+                    "Remote workers stream via the relay only; list their slots in "
+                    "fleet.net.remote_workers so the roster expects no local file."
+                ),
+                data={"missing": miss},
+            )
+        )
 
     from ..resilience.resume import read_manifest
 

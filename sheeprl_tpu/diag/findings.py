@@ -913,6 +913,53 @@ def detect_incomplete_stream(tl: Timeline, cfg: Any = None) -> List[Finding]:
     ]
 
 
+def detect_slo_alerts(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """SLO burn alerts the live aggregator raised DURING the run: the
+    online plane (`diag.live.slo` rules evaluated over the sliding window)
+    writes schema'd ``alert`` events onto the main stream exactly so the
+    post-mortem finds them — a breach that fired live must not read as
+    'the run looks fine' afterwards."""
+    fired = [rec for rec in tl.of("alert") if rec.get("state") == "firing"]
+    if not fired:
+        return []
+    by_rule: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in fired:
+        by_rule.setdefault(str(rec.get("rule") or "rule"), []).append(rec)
+    worst = (
+        "critical"
+        if any(rec.get("severity") == "critical" for rec in fired)
+        else "warning"
+    )
+    parts = []
+    for rule, recs in sorted(by_rule.items()):
+        last = recs[-1]
+        bound = last.get("threshold")
+        parts.append(
+            f"{rule}: {last.get('metric')} = {last.get('value')}"
+            + (f" vs bound {bound}" if bound is not None else "")
+            + (f" ({len(recs)}x)" if len(recs) > 1 else "")
+        )
+    steps = [int(rec.get("step") or 0) for rec in fired]
+    return [
+        Finding(
+            code="slo_alert",
+            severity=worst,
+            title=f"{len(fired)} SLO burn alert(s) fired live across {len(by_rule)} rule(s)",
+            detail="; ".join(parts),
+            remediation=(
+                "The live aggregator's burn-rate rules (diag.live.slo) breached "
+                "during the run. Inspect the window around each firing with "
+                "`sheeprl_tpu trace run_dir=...`, then either fix the regression "
+                "the rule caught or re-tune the rule's bound/burn_frac if the "
+                "expectation changed."
+            ),
+            step_first=min(steps),
+            step_last=max(steps),
+            data={"rules": sorted(by_rule), "alerts": fired[:10]},
+        )
+    ]
+
+
 DETECTORS: List[Callable[[Timeline, Any], List[Finding]]] = [
     detect_retrace_storm,
     detect_overlap_starvation,
@@ -931,6 +978,7 @@ DETECTORS: List[Callable[[Timeline, Any], List[Finding]]] = [
     detect_cross_process_stall,
     detect_flywheel_staleness,
     detect_replicated_giant,
+    detect_slo_alerts,
     detect_incomplete_stream,
 ]
 

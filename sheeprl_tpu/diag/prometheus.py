@@ -49,14 +49,23 @@ def _fmt(v: float) -> str:
     return str(int(f)) if f.is_integer() else repr(f)
 
 
+def _label_suffix(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in sorted(labels.items())) + "}"
+
+
 class Counter:
-    """Monotonically increasing value."""
+    """Monotonically increasing value. With ``labels`` this is one labeled
+    child of a metric family (several counters share a name, e.g.
+    ``slo_alerts_total{rule=...}``)."""
 
     kind = "counter"
 
-    def __init__(self, name: str, help: str = "") -> None:
+    def __init__(self, name: str, help: str = "", labels: Optional[Dict[str, str]] = None) -> None:
         self.name = name
         self.help = help
+        self.labels = dict(labels or {})
         self._lock = threading.Lock()
         self._value = 0.0
 
@@ -72,17 +81,18 @@ class Counter:
             return self._value
 
     def samples(self) -> List[Tuple[str, float]]:
-        return [(self.name, self.value)]
+        return [(f"{self.name}{_label_suffix(self.labels)}", self.value)]
 
 
 class Gauge:
-    """Set-to-current value."""
+    """Set-to-current value (optionally one labeled child of a family)."""
 
     kind = "gauge"
 
-    def __init__(self, name: str, help: str = "") -> None:
+    def __init__(self, name: str, help: str = "", labels: Optional[Dict[str, str]] = None) -> None:
         self.name = name
         self.help = help
+        self.labels = dict(labels or {})
         self._lock = threading.Lock()
         self._value = 0.0
 
@@ -100,7 +110,7 @@ class Gauge:
             return self._value
 
     def samples(self) -> List[Tuple[str, float]]:
-        return [(self.name, self.value)]
+        return [(f"{self.name}{_label_suffix(self.labels)}", self.value)]
 
 
 class Histogram:
@@ -208,6 +218,23 @@ class Registry:
         self.prefix = prefix
         self._lock = threading.Lock()  # guards the name→metric map only
         self._metrics: Dict[str, Any] = {}
+        self._bucket_overrides: Dict[str, Tuple[float, ...]] = {}
+
+    def set_bucket_overrides(self, overrides: Optional[Dict[str, Sequence[float]]]) -> None:
+        """Per-metric histogram bucket ladders (``diag.prometheus.buckets``):
+        keyed by the metric's family name, with or without the registry
+        prefix. Overrides apply at a family's FIRST creation — set them
+        before any event reaches ``observe_event``. A sub-ms ``jit_step``
+        and a ~50ms ``broker_put`` sharing one default ladder land in the
+        same two buckets; the override gives each its own resolution."""
+        self._bucket_overrides = {}
+        for name, bounds in (overrides or {}).items():
+            try:
+                ladder = tuple(sorted(float(b) for b in bounds))
+            except (TypeError, ValueError):
+                continue
+            if ladder:
+                self._bucket_overrides[str(name)] = ladder
 
     def _get(self, cls: Any, name: str, help: str, labels: Optional[Dict[str, str]] = None, **kw: Any) -> Any:
         name = f"{self.prefix}_{name}" if self.prefix and not name.startswith(self.prefix) else name
@@ -225,11 +252,11 @@ class Registry:
                 raise TypeError(f"metric {key} already registered as {type(m).__name__}")
             return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get(Counter, name, help)
+    def counter(self, name: str, help: str = "", labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get(Counter, name, help, labels=labels)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get(Gauge, name, help)
+    def gauge(self, name: str, help: str = "", labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get(Gauge, name, help, labels=labels)
 
     def histogram(
         self,
@@ -238,7 +265,14 @@ class Registry:
         buckets: Sequence[float] = SECONDS_BUCKETS,
         labels: Optional[Dict[str, str]] = None,
     ) -> Histogram:
-        return self._get(Histogram, name, help, labels=labels, buckets=buckets)
+        override = self._bucket_overrides.get(name) or self._bucket_overrides.get(
+            f"{self.prefix}_{name}" if self.prefix else name
+        )
+        if override is None and name.startswith(f"{self.prefix}_"):
+            override = self._bucket_overrides.get(name[len(self.prefix) + 1 :])
+        return self._get(
+            Histogram, name, help, labels=labels, buckets=override if override else buckets
+        )
 
     def metrics(self) -> Iterable[Any]:
         with self._lock:
@@ -452,11 +486,24 @@ class Registry:
 
 
 class PrometheusServer:
-    """Stdlib ThreadingHTTPServer exposing ``GET /metrics`` for a Registry."""
+    """Stdlib ThreadingHTTPServer exposing ``GET /metrics`` for a Registry.
 
-    def __init__(self, registry: Registry, host: str = "127.0.0.1", port: int = 9100) -> None:
+    With an ``aggregator`` attached (``diag/aggregator.py``) the same
+    endpoint also serves ``GET /live`` — the aggregator's JSON rollup
+    snapshot (per-role/per-stage windows, binding stage, active alerts) —
+    and ``/metrics`` is federated: relayed roles' events were mirrored into
+    the same registry, so one scrape covers the whole run."""
+
+    def __init__(
+        self,
+        registry: Registry,
+        host: str = "127.0.0.1",
+        port: int = 9100,
+        aggregator: Optional[Any] = None,
+    ) -> None:
         self.registry = registry
         self.host = host
+        self.aggregator = aggregator
         self._requested_port = int(port)
         self._httpd: Any = None
         self._thread: Optional[threading.Thread] = None
@@ -471,6 +518,7 @@ class PrometheusServer:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         registry = self.registry
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -479,13 +527,25 @@ class PrometheusServer:
                 pass
 
             def do_GET(self) -> None:
-                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
-                    body = b"not found (try /metrics)\n"
-                    self.send_response(404)
-                else:
+                path = self.path.split("?", 1)[0]
+                ctype = CONTENT_TYPE
+                if path == "/live" and server.aggregator is not None:
+                    import json
+
+                    try:
+                        snap = server.aggregator.snapshot()
+                    except Exception as err:
+                        snap = {"error": f"{type(err).__name__}: {err}"}
+                    body = (json.dumps(snap, default=str) + "\n").encode()
+                    ctype = "application/json"
+                    self.send_response(200)
+                elif path in ("/metrics", "/"):
                     body = registry.render().encode()
                     self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
+                else:
+                    body = b"not found (try /metrics or /live)\n"
+                    self.send_response(404)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -503,6 +563,8 @@ class PrometheusServer:
             self._thread = None
 
 
-def start_http_server(registry: Registry, port: int, host: str = "127.0.0.1") -> PrometheusServer:
-    """Convenience: build + start a `/metrics` endpoint for `registry`."""
-    return PrometheusServer(registry, host=host, port=port).start()
+def start_http_server(
+    registry: Registry, port: int, host: str = "127.0.0.1", aggregator: Optional[Any] = None
+) -> PrometheusServer:
+    """Convenience: build + start a `/metrics` (+`/live`) endpoint."""
+    return PrometheusServer(registry, host=host, port=port, aggregator=aggregator).start()

@@ -45,6 +45,7 @@ __all__ = [
     "discover_streams",
     "main",
     "merge_streams",
+    "missing_streams",
     "render_text",
     "stream_clock_offset",
 ]
@@ -79,13 +80,55 @@ def discover_streams(log_dir: Any) -> List[Tuple[str, Path]]:
             out.append((name, path))
 
     add("main", log_dir / "telemetry.jsonl")
-    for group in ("workers", "replicas"):
+    for group in ("workers", "replicas", "brokers"):
         base = log_dir / group
         if base.is_dir():
             for sub in sorted(base.iterdir()):
                 add(sub.name, sub / "telemetry.jsonl")
     for extra in ("gateway", "serve", "flywheel"):
         add(extra, log_dir / extra / "telemetry.jsonl")
+    return out
+
+
+def missing_streams(cfg: Any, discovered: Sequence[str]) -> List[Dict[str, Any]]:
+    """Discovered streams vs the roster the run config implies: a fleet of
+    N workers should have N ``worker_NNN`` streams (minus slots the config
+    marks remote — those are relay-only, their files live on the remote
+    host), and a gateway run with R replicas should have R ``replica_NNN``
+    streams. A stream that never appeared usually means a process died
+    before its first write or telemetry was silently misconfigured — the
+    kind of blind spot that otherwise reads as "the run looks fine"."""
+    names = set(discovered)
+    out: List[Dict[str, Any]] = []
+    if cfg is None:
+        return out
+    sel = cfg.select if hasattr(cfg, "select") else (lambda p, d=None: d)
+    workers = int(sel("algo.fleet.workers", 0) or 0)
+    if workers > 0 and "main" in names:
+        remote = {int(i) for i in (sel("fleet.net.remote_workers", None) or [])}
+        for i in range(workers):
+            name = f"worker_{i:03d}"
+            if name in names or i in remote:
+                continue
+            out.append(
+                {
+                    "stream": name,
+                    "role": "worker",
+                    "why": "fleet worker stream never appeared under workers/",
+                }
+            )
+    if "gateway" in names:
+        replicas = int(sel("gateway.replicas", 0) or 0)
+        for i in range(replicas):
+            name = f"replica_{i:03d}"
+            if name not in names:
+                out.append(
+                    {
+                        "stream": name,
+                        "role": "replica",
+                        "why": "replica stream never appeared under replicas/",
+                    }
+                )
     return out
 
 
@@ -312,6 +355,16 @@ def analyze(
         "top": slowest,
         "profiles": profiles,
     }
+    # roster check: the run's saved config says which streams SHOULD exist
+    run_cfg = None
+    if (log_dir / "config.yaml").is_file():
+        try:
+            from ..config import load_config_file
+
+            run_cfg = load_config_file(log_dir / "config.yaml")
+        except Exception:
+            run_cfg = None
+    report["missing_streams"] = missing_streams(run_cfg, [s["name"] for s in streams])
     if trace_id is not None:
         match = next((v for v in views if v["trace_id"].startswith(str(trace_id))), None)
         if match is not None:
@@ -341,6 +394,8 @@ def render_text(report: Dict[str, Any]) -> str:
         note = f", clock offset {s['clock_offset_s']:+.3f}s" if s["clock_offset_s"] else ""
         err = f", {s['parse_errors']} torn line(s)" if s["parse_errors"] else ""
         lines.append(f"  stream {s['name']}: {s['events']} events{note}{err}")
+    for miss in report.get("missing_streams") or []:
+        lines.append(f"  stream {miss['stream']}: MISSING — {miss['why']}")
     kinds = ", ".join(f"{n} {k}" for k, n in report["kinds"].items()) or "none"
     lines.append(f"  traces: {report['traces']} ({kinds})")
     for kind in ("round", "request"):

@@ -91,6 +91,7 @@ class FleetEngine:
         telem: Any = None,
         guard: Any = None,
         trace_spans: bool = True,
+        relay: Any = None,
     ) -> None:
         self.enabled = bool(enabled) and int(workers) > 0
         self.workers = int(workers)
@@ -113,6 +114,7 @@ class FleetEngine:
         self.guard = guard
         self.seed = int(seed)
         self.trace_spans = bool(trace_spans)
+        self.relay_cfg: Dict[str, Any] = dict(relay or {})
 
         self.sup: Optional[FleetSupervisor] = None
         self.num_envs = 0
@@ -194,6 +196,13 @@ class FleetEngine:
             telem=telem,
             guard=guard,
             trace_spans=bool(opt("metric.telemetry.trace_spans", True)),
+            relay={
+                "enabled": bool(opt("fleet.relay.enabled", True)),
+                "sample": float(opt("fleet.relay.sample", 1.0)),
+                "flush_s": float(opt("fleet.relay.flush_s", 2.0)),
+                "max_batch_kb": int(opt("fleet.relay.max_batch_kb", 64)),
+                "max_buffer": int(opt("fleet.relay.max_buffer", 512)),
+            },
         )
 
     # -- lifecycle ---------------------------------------------------------
@@ -227,6 +236,7 @@ class FleetEngine:
             net=self.net,
             remote_workers=self.remote_workers,
             shutdown_drain_s=self.shutdown_drain_s,
+            relay=self.relay_cfg,
             # workers write their own telemetry streams under the run dir
             # (workers/worker_NNN/); the facade's log_dir is that root —
             # only when telemetry is on at all, so a metrics-off run never
@@ -266,6 +276,17 @@ class FleetEngine:
         sup = self.sup
         faults_before = sup.crashes + sup.hangs + sup.torn_packets
         sup.monitor(step)
+        # relayed telemetry rides the same sweep: batches go straight to the
+        # facade's live aggregator (never into the learner's own JSONL — the
+        # workers' local files stay the only durable copy, so doctor's merge
+        # never sees an event twice)
+        ingest = getattr(self.telem, "ingest_relayed", None)
+        if ingest is not None:
+            for batch in sup.drain_telem():
+                try:
+                    ingest(batch)
+                except Exception:
+                    pass
         for handle in sup.handles:
             frames: List[Any] = []
             if handle.salvage:
@@ -532,6 +553,9 @@ class FleetEngine:
             rec["reconnects"] = int(ns["reconnects"])
             rec["dup_frames"] = int(ns["dup_frames"])
             rec["disconnects"] = int(self.sup.disconnects)
+        dropped = self.sup.telem_dropped()
+        if dropped:
+            rec["relay_dropped"] = int(dropped)
         try:
             self.telem.emit(rec)
         except Exception:

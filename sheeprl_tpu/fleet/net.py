@@ -92,6 +92,10 @@ T_CTRL = 8
 T_PUB = 9
 T_PULL = 10
 T_PARAMS = 11
+T_TELEM = 12  # worker→learner relayed telemetry batch (best-effort, unacked)
+
+# learner-side cap on buffered (not-yet-drained) relay batches per link
+_TELEM_BUFFER_BATCHES = 64
 
 # HELLO is a FIXED struct, never pickle: it arrives from an unauthenticated
 # peer (fleet.net.host=0.0.0.0 is the documented multi-host setup) and must
@@ -452,6 +456,10 @@ class LearnerChannel:
         self._last_resend_req = 0.0
         self._closed = False
         self.dup_frames = 0
+        # relayed telemetry batches (T_TELEM): bounded — the live window is
+        # advisory, a slow aggregator drops the oldest batch, never the link
+        self._telem: deque = deque()
+        self.telem_dropped = 0
 
     # -- link state --------------------------------------------------------
     def attach(self, conn: socket.socket) -> int:
@@ -549,6 +557,20 @@ class LearnerChannel:
             # credit delivery is self-healing even across lost CREDITs —
             # a parked worker heartbeats, so it always re-learns its window
             self._send_credit()
+        elif ftype == T_TELEM:
+            # best-effort, out-of-band of the DATA seq space: a torn or
+            # unparseable batch is counted and dropped (the worker's local
+            # file still has the events), never a link error
+            try:
+                batch = pickle.loads(payload)
+            except Exception:
+                self.telem_dropped += 1
+                return
+            with self._lock:
+                if len(self._telem) >= _TELEM_BUFFER_BATCHES:
+                    self._telem.popleft()
+                    self.telem_dropped += 1
+                self._telem.append(batch)
         elif ftype == T_PULL:
             with self._lock:
                 pub = self._latest_pub
@@ -736,6 +758,17 @@ class LearnerChannel:
         if out:
             # room freed learner-side → grow the worker's window
             self._send_credit()
+        return out
+
+    def drain_telem(self, limit: int = 64) -> List[Any]:
+        """Pop every buffered relay batch (supervisor/engine poll path)."""
+        out: List[Any] = []
+        with self._lock:
+            for _ in range(max(0, int(limit))):
+                try:
+                    out.append(self._telem.popleft())
+                except IndexError:
+                    break
         return out
 
     def close(self) -> None:
@@ -1064,7 +1097,7 @@ class WorkerSocketChannel:
                     n = self._attempt
                 delay = min(self.net.max_backoff_s, self.net.backoff_s * (2 ** max(0, n - 1)))
                 delay *= max(0.0, 1.0 + self._rng.uniform(-self.net.jitter, self.net.jitter))
-                _emit(
+                _emit(  # lint: ok[hot-loop-emit] once per reconnect attempt, backoff-bounded
                     self.emit,
                     {
                         "event": "net",
@@ -1311,6 +1344,18 @@ class WorkerSocketChannel:
             return self._ctrl_q.popleft()
         except IndexError:
             raise _q.Empty from None
+
+    def telem_put(self, batch: Any) -> bool:
+        """Relay one telemetry batch upstream (T_TELEM). Best-effort and
+        bounded: rides the ordinary deadline-bounded frame write, returns
+        False (caller counts the drop) when the link is down or the write
+        times out — never blocks the worker loop on the relay."""
+        try:
+            return self._send(
+                T_TELEM, pickle.dumps(batch, protocol=pickle.HIGHEST_PROTOCOL)
+            )
+        except Exception:
+            return False
 
     def data_put(self, frame: Any, timeout: Optional[float] = None) -> None:
         """Credit-gated transmit of one protocol frame tuple. Blocks (up to

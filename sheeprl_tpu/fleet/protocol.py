@@ -141,9 +141,22 @@ class WorkerChannel:
     def __init__(self, ctx: Any, queue_depth: int = 4):
         self.data = ctx.Queue(maxsize=max(1, int(queue_depth)))
         self.ctrl = ctx.Queue()
+        # relayed telemetry batches (worker→learner, best-effort): small and
+        # bounded — the relay is advisory, a full queue means the batch is
+        # dropped worker-side (counted there), never backpressure
+        self.telem = ctx.Queue(maxsize=64)
         self.heartbeat = ctx.Value("q", 0, lock=False)
         self.param_version = ctx.Value("q", 0, lock=False)
         self.stop = ctx.Event()
+
+    # -- worker side -------------------------------------------------------
+    def telem_put(self, batch: Any) -> bool:
+        """Non-blocking relay of one telemetry batch; False == dropped."""
+        try:
+            self.telem.put_nowait(batch)
+            return True
+        except Exception:
+            return False
 
     # -- learner side ------------------------------------------------------
     def drain_data(self, limit: int = 1024) -> List[Any]:
@@ -165,8 +178,23 @@ class WorkerChannel:
                 break
         return out
 
+    def drain_telem(self, limit: int = 64) -> List[Any]:
+        """Non-blocking sweep of relayed telemetry batches — the defensive
+        posture of :meth:`drain_data`: any failure ends the sweep."""
+        import queue as _q
+
+        out: List[Any] = []
+        for _ in range(limit):
+            try:
+                out.append(self.telem.get_nowait())
+            except _q.Empty:
+                break
+            except Exception:
+                break
+        return out
+
     def close(self) -> None:
-        for q in (self.data, self.ctrl):
+        for q in (self.data, self.ctrl, self.telem):
             try:
                 q.close()
                 # do NOT join_thread(): a feeder mid-pickle on a dead queue

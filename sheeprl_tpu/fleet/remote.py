@@ -53,21 +53,26 @@ def main(argv: Optional[list] = None) -> int:
     os.environ.setdefault("XLA_FLAGS", "--xla_cpu_multi_thread_eigen=false")
 
     from ..config import Config
+    from ..telemetry.relay import TeeSink
     from .net import WorkerSocketChannel
-    from .worker import _resolve_program, fleet_worker_loop
+    from .worker import _resolve_program, attach_worker_relay, fleet_worker_loop
 
-    sink = None
+    local = None
     if args.log_dir:
         from ..telemetry.tracing import open_process_stream
 
-        sink = open_process_stream(args.log_dir, "worker", int(args.worker_id))
+        local = open_process_stream(args.log_dir, "worker", int(args.worker_id))
+    # tee even with no local file: the learner's spec says whether to relay,
+    # and a log-dir-less remote worker is exactly the stream the controlling
+    # host could never see before the relay existed
+    sink = TeeSink(local)
     channel = WorkerSocketChannel(
         host,
         int(port),
         int(args.worker_id),
         -1,  # "assign me": the learner's HELLO_ACK carries the incarnation
         str(args.token),
-        emit=(sink.write if sink is not None else None),
+        emit=sink.write,
     )
     deadline = time.monotonic() + float(args.spec_timeout_s)
     while channel.spec is None and time.monotonic() < deadline:
@@ -85,6 +90,7 @@ def main(argv: Optional[list] = None) -> int:
         )
         channel.close()
         return 3
+    attach_worker_relay(sink, channel, spec.get("relay") or {}, int(args.worker_id))
     cfg = Config(spec["cfg"])
     program = _resolve_program(str(spec["program"]))(
         cfg, int(args.worker_id), int(spec["num_workers"])
@@ -96,12 +102,11 @@ def main(argv: Optional[list] = None) -> int:
             program, channel, None, int(args.worker_id), channel.incarnation, sink
         )
     finally:
+        try:
+            sink.close()  # final relay flush rides the still-open channel
+        except Exception:
+            pass
         channel.close()
-        if sink is not None:
-            try:
-                sink.close()
-            except Exception:
-                pass
     return 0
 
 

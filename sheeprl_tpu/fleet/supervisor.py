@@ -110,6 +110,7 @@ class FleetSupervisor:
         net: Optional[NetConfig] = None,
         remote_workers: Optional[List[int]] = None,
         shutdown_drain_s: float = 10.0,
+        relay: Optional[Dict[str, Any]] = None,
     ):
         self.cfg = cfg
         self.telem = telem
@@ -133,6 +134,9 @@ class FleetSupervisor:
         self.net = net or NetConfig()
         self.remote_workers = [int(w) for w in (remote_workers or [])]
         self.shutdown_drain_s = float(shutdown_drain_s)
+        # relay knobs ride every spec (incl. the HELLO_ACK spec a remote
+        # worker receives) so all incarnations tee telemetry upstream
+        self.relay_cfg: Dict[str, Any] = dict(relay or {})
         # one listener + shared link counters for the whole fleet (socket
         # transport only); the token fences this run's workers from strays
         self.listener: Optional[FleetListener] = None
@@ -182,6 +186,7 @@ class FleetSupervisor:
             "initial_lifetime": self.progress_step // self.num_workers,
             "log_dir": self.log_dir,  # the worker's own telemetry stream root
             "trace": self.trace,
+            "relay": self.relay_cfg,
         }
         remote = handle.worker_id in self.remote_workers
         if self.transport == "socket":
@@ -588,6 +593,31 @@ class FleetSupervisor:
 
     def quarantined_ids(self) -> List[int]:
         return [h.worker_id for h in self.handles if h.state == "quarantined"]
+
+    def drain_telem(self) -> List[Any]:
+        """Sweep relayed telemetry batches off every live channel (both
+        transports expose ``drain_telem``). Best-effort like everything on
+        the relay path — a dead channel just contributes nothing."""
+        out: List[Any] = []
+        for h in self.handles:
+            ch = h.channel
+            if ch is None:
+                continue
+            drain = getattr(ch, "drain_telem", None)
+            if drain is None:
+                continue
+            try:
+                out.extend(drain())
+            except Exception:
+                pass
+        return out
+
+    def telem_dropped(self) -> int:
+        """Learner-side relay drop count (socket buffer overflows)."""
+        total = 0
+        for h in self.handles:
+            total += int(getattr(h.channel, "telem_dropped", 0) or 0)
+        return total
 
     def queue_depth_max(self) -> int:
         out = 0

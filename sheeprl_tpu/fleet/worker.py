@@ -56,10 +56,38 @@ from .protocol import (
     encode_packet,
 )
 
-__all__ = ["fleet_worker_loop", "worker_entry"]
+__all__ = ["attach_worker_relay", "fleet_worker_loop", "worker_entry"]
 
 _PUT_POLL_S = 0.1  # heartbeat cadence while parked on a full data queue
 _IDLE_POLL_S = 0.005  # param-sync wait granularity (PPO strict mode)
+
+
+def attach_worker_relay(sink: Any, channel: Any, relay_cfg: Dict[str, Any], worker_id: int) -> None:
+    """Bind a :class:`~sheeprl_tpu.telemetry.relay.RelaySink` to the
+    channel's ``telem_put`` and attach it to the worker's TeeSink. A no-op
+    unless the sink is a relay-ready tee AND the channel speaks telemetry —
+    the relay is strictly additive, never a reason a worker fails to start."""
+    from ..telemetry.relay import RelaySink, TeeSink
+
+    if not isinstance(sink, TeeSink) or channel is None:
+        return
+    put = getattr(channel, "telem_put", None)
+    if put is None:
+        return
+    try:
+        sink.attach_relay(
+            RelaySink(
+                put,
+                role="worker",
+                index=worker_id,
+                sample=float(relay_cfg.get("sample", 1.0)),
+                max_buffer=int(relay_cfg.get("max_buffer", 512)),
+                max_batch_bytes=int(relay_cfg.get("max_batch_kb", 64)) * 1024,
+                flush_s=float(relay_cfg.get("flush_s", 2.0)),
+            )
+        )
+    except Exception:
+        pass
 
 
 def _resolve_program(path: str):
@@ -244,6 +272,14 @@ def worker_entry(spec: Dict[str, Any], channel: Optional[WorkerChannel], chaos: 
                 emit=sink.write,
                 role="worker",
             )
+        relay_cfg = spec.get("relay") or {}
+        if sink is not None and relay_cfg.get("enabled", False):
+            # tee wrapper first (relay attached once the channel exists):
+            # the socket channel's own net events must flow through the
+            # same tee so they reach the aggregator too
+            from ..telemetry.relay import TeeSink
+
+            sink = TeeSink(sink)
         connect = spec.get("connect")
         if channel is None and connect is not None:
             from .net import WorkerSocketChannel
@@ -258,6 +294,7 @@ def worker_entry(spec: Dict[str, Any], channel: Optional[WorkerChannel], chaos: 
                 chaos=chaos,
                 emit=(sink.write if sink is not None else None),
             )
+        attach_worker_relay(sink, channel, relay_cfg, worker_id)
         cfg = Config(spec["cfg"])
         program = _resolve_program(str(spec["program"]))(
             cfg, worker_id, int(spec["num_workers"])

@@ -979,6 +979,23 @@ def _server_from_spec(spec: Dict[str, Any]) -> BrokerServer:
             spec["telemetry_dir"], "broker", int(spec.get("broker_id", 0)),
             incarnation=int(spec.get("incarnation", 0)),
         )
+        # in-band telemetry relay: with a relay_url (the gateway's
+        # POST /admin/telemetry) the broker's stream — repl lag, fsync p95,
+        # failover events — also shows up in the live plane
+        if spec.get("relay_url"):
+            from ..telemetry.relay import RelaySink, TeeSink, http_post_sender
+
+            tee = TeeSink(sink)
+            tee.attach_relay(
+                RelaySink(
+                    http_post_sender(str(spec["relay_url"])),
+                    role="broker",
+                    index=int(spec.get("broker_id", 0)),
+                    sample=float(spec.get("relay_sample", 1.0)),
+                    flush_s=float(spec.get("relay_flush_s", 2.0)),
+                )
+            )
+            sink = tee
         emit = sink.write
     chaos = None
     if spec.get("chaos"):
@@ -1076,6 +1093,9 @@ def run_brokerd_from_cfg(cfg: Any, block: bool = True) -> BrokerServer:
         "sync_replication": bool(sel("gateway.broker.sync_replication", True)),
         "repl_timeout_s": float(sel("gateway.broker.repl_timeout_s", 2.0)),
         "telemetry_dir": sel("gateway.broker.telemetry_dir", None),
+        "relay_url": sel("gateway.broker.relay_url", None),
+        "relay_sample": float(sel("gateway.broker.relay_sample", 1.0)),
+        "relay_flush_s": float(sel("gateway.broker.relay_flush_s", 2.0)),
     }
     server = _server_from_spec(spec)
     print(

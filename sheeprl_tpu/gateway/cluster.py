@@ -184,10 +184,35 @@ def build_cluster(
         log_every_s=float(sel("gateway.telemetry.log_every_s", 10.0)),
         trace_sample=float(sel("gateway.telemetry.trace_sample", 0.0) or 0.0),
     )
+    # live telemetry plane: a LiveAggregator on the gateway host ingests the
+    # gateway's own records plus every batch relayed to POST /admin/telemetry
+    # (replicas, brokerd) and serves GET /live snapshots + SLO burn alerts
+    if bool(sel("gateway.telemetry.live", True)):
+        from ..diag.aggregator import LiveAggregator
+        from ..diag.doctor import _load_diag_cfg
+
+        try:
+            gateway.live = LiveAggregator(
+                _load_diag_cfg(cfg),
+                emit=sink.write if sink is not None else None,
+                registry=gateway.stats.registry,
+            )
+        except Exception:
+            gateway.live = None  # observability must never block serving
     if start:
         manager.start()
         manager.wait_routable(timeout_s=float(sel("gateway.supervisor.spawn_grace_s", 120.0)))
         gateway.start()
+        # replicas spawned before the gateway's HTTP server existed — push
+        # the relay target now; later (re)spawns get it on first health
+        if gateway.live is not None and bool(sel("gateway.telemetry.relay.enabled", True)):
+            manager.set_relay(
+                f"http://{gateway.host}:{gateway.port}/admin/telemetry",
+                sample=float(sel("gateway.telemetry.relay.sample", 1.0)),
+                flush_s=float(sel("gateway.telemetry.relay.flush_s", 2.0)),
+                max_batch_kb=int(sel("gateway.telemetry.relay.max_batch_kb", 64)),
+                max_buffer=int(sel("gateway.telemetry.relay.max_buffer", 512)),
+            )
     return gateway
 
 
@@ -208,6 +233,26 @@ def gateway_from_checkpoint(ckpt_path: Any, cfg: Any, block: bool = True) -> Gat
     gateway = build_cluster(
         cfg, ckpt_path=ckpt_path, sink=sink, start=True, telemetry_dir=telemetry_dir
     )
+    if gateway.live is not None and sink is not None:
+        # discovery file for `sheeprl_tpu top`: the /live URL next to the
+        # gateway's telemetry.jsonl (same contract the training facade uses)
+        import json as _json
+        import os
+        import time as _time
+
+        try:
+            with open(pathlib.Path(sink.path).parent / "live.json", "w") as fh:
+                _json.dump(
+                    {
+                        "url": f"http://{gateway.host}:{gateway.port}/live",
+                        "metrics_url": f"http://{gateway.host}:{gateway.port}/metrics",
+                        "pid": os.getpid(),
+                        "t": _time.time(),
+                    },
+                    fh,
+                )
+        except OSError:
+            pass
     print(
         f"[gateway] {gateway.manager.num_replicas} replica(s) behind "
         f"http://{gateway.host}:{gateway.port}",

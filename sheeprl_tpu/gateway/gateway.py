@@ -354,6 +354,10 @@ class Gateway:
         self.max_attempts = max(1, int(max_attempts))
         self.shed_deterministic = bool(shed_deterministic)
         self._sink = sink
+        # attachment point for a diag.aggregator.LiveAggregator (wired by
+        # build_cluster): receives relayed replica/broker batches via
+        # POST /admin/telemetry and serves GET /live snapshots
+        self.live: Any = None
         self._log_every_s = float(log_every_s)
         # a request is traced when the client sent a traceparent; on top of
         # that, trace_sample self-originates a trace for that fraction of
@@ -752,6 +756,23 @@ class Gateway:
         )
         return registry.render()
 
+    def ingest_telemetry(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        """One relayed batch (``POST /admin/telemetry`` body) into the
+        attached aggregator; returns the accept/invalid counts the sender
+        sees. Without an aggregator the batch is acknowledged and dropped —
+        the sender's local stream is authoritative either way."""
+        if self.live is None:
+            return {"accepted": 0, "invalid": 0, "aggregator": False}
+        out = self.live.ingest_batch(batch)
+        return dict(out, aggregator=True) if isinstance(out, dict) else {"aggregator": True}
+
+    def _feed_live(self, rec: Dict[str, Any]) -> None:
+        if self.live is not None:
+            try:
+                self.live.ingest(rec, stream="gateway")
+            except Exception:
+                pass
+
     def _maybe_emit(self) -> None:
         if self._sink is None or self._log_every_s <= 0:
             return
@@ -760,7 +781,9 @@ class Gateway:
             return
         self._last_log = now
         try:
-            self._sink.write(self.gateway_record())
+            rec = self.gateway_record()
+            self._sink.write(rec)
+            self._feed_live(rec)
         except Exception:
             pass
 
@@ -800,7 +823,9 @@ class Gateway:
             self._http_thread = None
         if self._sink is not None:
             try:
-                self._sink.write(self.gateway_record())
+                rec = self.gateway_record()
+                self._sink.write(rec)
+                self._feed_live(rec)
             except Exception:
                 pass
 
@@ -838,12 +863,35 @@ def _make_handler(gw: "Gateway"):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path == "/live":
+                if gw.live is None:
+                    self._reply(404, {"error": "no live aggregator attached"})
+                else:
+                    self._reply(200, gw.live.snapshot())
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self) -> None:
             if self.path == "/admin/rolling_reload":
                 self._reply(200, {"results": gw.manager.rolling_reload()})
+                return
+            if self.path == "/admin/telemetry":
+                # in-band telemetry relay ingest: replicas (and brokerd) POST
+                # {"role","index","events",...} batches here; each event is
+                # schema-validated by the aggregator — invalid ones are
+                # counted and quarantined, never fatal
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    batch = json.loads(self.rfile.read(length) or b"{}")
+                    if not isinstance(batch, dict):
+                        raise ValueError("body must be a JSON batch object")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                try:
+                    self._reply(200, gw.ingest_telemetry(batch))
+                except Exception as e:  # ingest must never 500 the relay hop
+                    self._reply(200, {"accepted": 0, "invalid": 0, "error": str(e)})
                 return
             if self.path == "/admin/profile":
                 # on-demand remote profiling fan-out: open a windowed

@@ -93,11 +93,15 @@ def _build_replica_server(spec: Dict[str, Any]) -> Any:
     if spec.get("telemetry_dir"):
         from ..telemetry.tracing import open_process_stream
 
-        sink = open_process_stream(
-            spec["telemetry_dir"],
-            "replica",
-            int(spec.get("replica_id", 0)),
-            incarnation=int(spec.get("incarnation", 0)),
+        from ..telemetry.relay import TeeSink
+
+        sink = TeeSink(
+            open_process_stream(
+                spec["telemetry_dir"],
+                "replica",
+                int(spec.get("replica_id", 0)),
+                incarnation=int(spec.get("incarnation", 0)),
+            )
         )
     reloader = None
     if mode == "checkpoint":
@@ -335,6 +339,10 @@ class ReplicaManager:
         # even when the monitor and N request threads observe it at once
         self._fault_lock = threading.Lock()
         self._reload_lock = threading.Lock()
+        # telemetry relay target: pushed to every replica as it first turns
+        # healthy (and immediately to already-healthy ones on set_relay)
+        self._relay_url: Optional[str] = None
+        self._relay_opts: Dict[str, Any] = {}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ReplicaManager":
@@ -429,7 +437,36 @@ class ReplicaManager:
             # the replica answers by emitting a `clock` event on its OWN
             # stream, which diag/trace.py uses to align the streams
             self._clock_probe(handle)
+            if self._relay_url:
+                self._relay_probe(handle)
         return True
+
+    def set_relay(self, url: str, **opts: Any) -> None:
+        """Point every replica's telemetry relay at ``url`` (the gateway's
+        ``POST /admin/telemetry``). Replicas spawn before the gateway's HTTP
+        server exists, so the URL is pushed post-hoc: immediately to every
+        already-healthy replica, and to each later (re)spawn as its first
+        health check passes — a respawned incarnation re-attaches without
+        any caller involvement."""
+        self._relay_url = str(url)
+        self._relay_opts = dict(opts)
+        for handle in self.handles:
+            if handle.last_healthy > 0.0:
+                self._relay_probe(handle)
+
+    def _relay_probe(self, handle: ReplicaHandle) -> None:
+        try:
+            body = dict(self._relay_opts, url=self._relay_url)
+            req = urllib.request.Request(
+                f"{handle.url}/admin/relay",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=self.health_timeout_s):
+                pass
+        except Exception:
+            pass  # best-effort: the replica's local stream is authoritative
 
     def _clock_probe(self, handle: ReplicaHandle) -> None:
         try:

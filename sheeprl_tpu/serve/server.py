@@ -343,6 +343,9 @@ def _make_handler(server: "PolicyServer"):
             if self.path in ("/admin/profile",):
                 self._admin_profile()
                 return
+            if self.path in ("/admin/relay",):
+                self._admin_relay()
+                return
             if self.path not in ("/v1/act", "/act"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
@@ -531,6 +534,38 @@ def _make_handler(server: "PolicyServer"):
                 )
                 return
             self._reply(200, {"started": True, "trace_dir": trace_dir, "duration_s": duration_s})
+
+        def _admin_relay(self) -> None:
+            """Attach (or retarget) the in-band telemetry relay: from here on
+            every event this replica writes locally is also batched upstream
+            to the given URL (the gateway's POST /admin/telemetry). Pushed by
+            the ReplicaManager once per healthy replica — best-effort, the
+            local stream is authoritative either way."""
+            from ..telemetry.relay import RelaySink, TeeSink, http_post_sender
+
+            payload = self._read_json()
+            url = payload.get("url")
+            if not isinstance(url, str) or not url:
+                self._reply(400, {"error": "body must carry a relay 'url'"})
+                return
+            if not isinstance(server.sink, TeeSink):
+                self._reply(409, {"error": "replica sink is not relay-capable"})
+                return
+            try:
+                relay = RelaySink(
+                    http_post_sender(url),
+                    role="replica",
+                    index=server.replica_id,
+                    sample=float(payload.get("sample", 1.0)),
+                    max_buffer=int(payload.get("max_buffer", 512)),
+                    max_batch_bytes=int(payload.get("max_batch_kb", 64)) * 1024,
+                    flush_s=float(payload.get("flush_s", 2.0)),
+                )
+            except (TypeError, ValueError) as e:
+                self._reply(400, {"error": f"bad relay options: {e}"})
+                return
+            server.sink.attach_relay(relay)
+            self._reply(200, {"attached": True, "url": url})
 
     return Handler
 

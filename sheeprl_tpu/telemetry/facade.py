@@ -103,24 +103,78 @@ class Telemetry:
                 if self.prom is not None
                 else None,
             )
+            # post-run callers (the bench drivers stamping binding_stage
+            # onto their records) need the stream's location
+            from ..utils import run_info
+
+            run_info.last_run["log_dir"] = str(log_dir)
+        # the diag config governs the live plane (aggregator window, SLO
+        # rules, per-metric bucket overrides): the run's own `diag` section
+        # when composed, else the packaged configs/diag/default.yaml
+        self._diag_cfg = None
+        if self.enabled and self.rank == 0:
+            try:
+                from ..diag.doctor import _load_diag_cfg
+
+                self._diag_cfg = _load_diag_cfg(cfg)
+            except Exception:
+                self._diag_cfg = None
+
+        def dsel(path: str, default: Any = None) -> Any:
+            c = self._diag_cfg
+            if c is None or not hasattr(c, "select"):
+                return default
+            val = c.select(path, default)
+            return default if val is None else val
+
+        # the central live aggregator (diag/aggregator.py): windowed rollups
+        # + binding-stage attribution + SLO burn alerts over this process's
+        # own events plus everything the relay forwards. Rank 0 only — the
+        # controlling host is where all relayed streams converge.
+        self.live = None
+        if self.enabled and self.rank == 0 and bool(dsel("diag.live.enabled", True)):
+            try:
+                from ..diag.aggregator import LiveAggregator
+
+                self.live = LiveAggregator(self._diag_cfg, emit=None, registry=None)
+            except Exception as err:
+                print(f"[telemetry] live aggregator disabled: {err}", file=sys.stderr)
+                self.live = None
         # live Prometheus export (diag/prometheus.py): a /metrics endpoint
         # fed by mirroring the same events the JSONL sink gets. Off by
-        # default (port 0); rank 0 only — one scrape surface per run.
+        # default (port 0); rank 0 only — one scrape surface per run. The
+        # same server answers GET /live with the aggregator snapshot.
         self.prom = None
         self._prom_server = None
+        self._live_path: Optional[str] = None
         prom_port = int(sel("metric.telemetry.prometheus_port", 0) or 0)
         if self.enabled and self.rank == 0 and prom_port > 0:
             try:
                 from ..diag.prometheus import Registry, start_http_server
 
                 self.prom = Registry()
+                buckets = dsel("diag.prometheus.buckets")
+                if buckets:
+                    bd = buckets.to_dict() if hasattr(buckets, "to_dict") else buckets
+                    if isinstance(bd, dict):
+                        self.prom.set_bucket_overrides(bd)
+                prom_host = str(sel("metric.telemetry.prometheus_host", "127.0.0.1"))
                 self._prom_server = start_http_server(
-                    self.prom, prom_port, host=str(sel("metric.telemetry.prometheus_host", "127.0.0.1"))
+                    self.prom, prom_port, host=prom_host, aggregator=self.live
                 )
+                if log_dir:
+                    # discovery file for `sheeprl_tpu top`: where /live is
+                    self._live_path = os.path.join(log_dir, "live.json")
+                    self._write_live_discovery(prom_host, prom_port)
             except Exception as err:
                 print(f"[telemetry] prometheus export disabled: {err}", file=sys.stderr)
                 self.prom = None
                 self._prom_server = None
+        if self.live is not None:
+            # wired AFTER the registry exists: alerts land on the main
+            # stream via _emit and relayed events federate into /metrics
+            self.live.emit = self._emit
+            self.live.registry = self.prom
         # the startup heartbeat is intentionally independent of log_level:
         # a run degraded to cpu-fallback must say so even with metrics off
         hb_on = bool(sel("metric.telemetry.heartbeat", True))
@@ -167,6 +221,28 @@ class Telemetry:
     ) -> "Telemetry":
         return cls(cfg, log_dir, rank, logger=logger, aggregator_keys=aggregator_keys)
 
+    def _write_live_discovery(self, host: str, port: int) -> None:
+        """Drop ``<log_dir>/live.json`` so `sheeprl_tpu top` can find the
+        running aggregator's /live endpoint from just the run dir."""
+        if self._live_path is None:
+            return
+        try:
+            import json
+
+            actual = int(getattr(self._prom_server, "port", port) or port)
+            with open(self._live_path, "w") as fh:
+                json.dump(
+                    {
+                        "url": f"http://{host}:{actual}/live",
+                        "metrics_url": f"http://{host}:{actual}/metrics",
+                        "pid": os.getpid(),
+                        "t": time.time(),
+                    },
+                    fh,
+                )
+        except Exception:
+            self._live_path = None
+
     # -- sinks -------------------------------------------------------------
     def _emit(self, rec: Dict[str, Any]) -> None:
         if self.jsonl is not None:
@@ -181,12 +257,31 @@ class Telemetry:
                 self.prom.observe_event(rec)
             except Exception:
                 pass
+        if self.live is not None:
+            # the aggregator sees the learner's own stream too — rollups and
+            # binding-stage attribution need both sides of every trace
+            try:
+                self.live.ingest(rec)
+            except Exception:
+                pass
 
     def emit(self, rec: Dict[str, Any]) -> None:
         """Write one schema-validated event to the JSONL stream — the public
         hook subsystems (resilience, serving) use; safe from any thread
         (JsonlSink locks) and a no-op when the sink is off/closed."""
         self._emit(rec)
+
+    def ingest_relayed(self, batch: Dict[str, Any]) -> None:
+        """Hand one relayed telemetry batch (fleet T_TELEM frame, gateway
+        ``POST /admin/telemetry`` body) to the live aggregator. Relayed
+        events are validated there and NEVER written to this process's
+        JSONL — the emitting process's local file is the durable copy, and
+        doctor's stream merge must not see any event twice."""
+        if self.live is not None:
+            try:
+                self.live.ingest_batch(batch)
+            except Exception:
+                pass
 
     # -- spans / annotations ----------------------------------------------
     def span(self, name: str) -> Span:
@@ -382,6 +477,13 @@ class Telemetry:
             self._prom_server.stop()
             self._prom_server = None
             self.prom = None
+        if self._live_path is not None:
+            try:
+                os.remove(self._live_path)  # the endpoint just went away
+            except OSError:
+                pass
+            self._live_path = None
+        self.live = None
         if self.jsonl is not None:
             self.jsonl.close()
             self.jsonl = None

@@ -68,6 +68,7 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
     # bench driver records (BENCH_*.json contract: metric/value/unit/
     # vs_baseline; platform/device_kind/wall_capped/mfu ride along)
     "bench": {
+        "binding_stage": (False, _STR),  # offline trace attribution (informational)
         "metric": (True, _STR),
         "value": (True, _NUM),
         "unit": (True, _STR),
@@ -263,6 +264,9 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "reconnects": (False, _NUM),
         "dup_frames": (False, _NUM),
         "disconnects": (False, _NUM),
+        # learner-side relay drops (telemetry batches the learner's bounded
+        # buffer shed; worker-side drops ride each worker's `relay` events)
+        "relay_dropped": (False, _NUM),
     },
     # socket-transport link lifecycle (sheeprl_tpu/fleet/net.py): learner
     # events (listen | accept | reconnect | refuse | disconnect | resync |
@@ -470,6 +474,7 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
     # latency percentiles + shed rate + failover recovery, gated run-over-run
     # by scripts/bench_compare.py with lower-is-better direction
     "serve_bench": {
+        "binding_stage": (False, _STR),  # offline trace attribution (informational)
         "metric": (True, _STR),
         "value": (True, _NUM),
         "unit": (True, _STR),
@@ -519,6 +524,7 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
     # that joined back to a capture trace id (must be 1.0); `acked_loss`
     # counts counter-continuity mismatches across the reload (invariant 0).
     "flywheel_bench": {
+        "binding_stage": (False, _STR),  # offline trace attribution (informational)
         "metric": (True, _STR),
         "value": (True, _NUM),
         "unit": (True, _STR),
@@ -543,6 +549,40 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "acked": (False, _NUM),
         "duration_s": (False, _NUM),
         "platform": (False, _STR),
+    },
+    # relay sink flush accounting (telemetry/relay.py): one per flush
+    # cadence on the EMITTING process's own stream. `sent`/`dropped` are
+    # cumulative counters — the aggregator keys SLO rules like
+    # "relay drops == 0" on the latest value, and doctor can see where
+    # backpressure bit without the relayed copy (the drop happened because
+    # the relayed copy could not be sent).
+    "relay": {
+        "role": (True, _STR),
+        "sent": (True, _NUM),
+        "dropped": (True, _NUM),
+        "batches": (True, _NUM),
+        "worker": (False, _NUM),
+        "replica": (False, _NUM),
+        "index": (False, _NUM),
+        "detail": (False, _STR),
+    },
+    # SLO burn alert (diag/aggregator.py): a configured rule
+    # (diag.live.slo) breached for at least its burn fraction of the
+    # sliding window. `rule` is the configured rule name (a LABEL — the
+    # Prometheus mirror is `slo_alerts_total{rule=...}`), `metric` the
+    # dotted snapshot path it watches, `value` the observed value that
+    # breached and `threshold` the configured bound. Raised alerts land on
+    # the aggregator host's main stream so doctor finds them post-hoc.
+    "alert": {
+        "rule": (True, _STR),
+        "state": (True, _STR),  # firing | resolved
+        "metric": (True, _STR),
+        "value": (False, _NUM),
+        "threshold": (False, _NUM),
+        "burn_frac": (False, _NUM),
+        "window_s": (False, _NUM),
+        "severity": (False, _STR),  # critical | warning
+        "detail": (False, _STR),
     },
 }
 

@@ -17,6 +17,7 @@ from sheeprl_tpu.analysis import all_rules, run_paths
 from sheeprl_tpu.analysis.engine import main as lint_main
 from sheeprl_tpu.analysis.rules.donation import UseAfterDonateRule
 from sheeprl_tpu.analysis.rules.host_sync import HostSyncRule
+from sheeprl_tpu.analysis.rules.hot_loop import HotLoopEmitRule
 from sheeprl_tpu.analysis.rules.retrace import RetraceHazardRule
 from sheeprl_tpu.analysis.rules.pspec import PspecLiteralRule
 from sheeprl_tpu.analysis.rules.rng import RngReuseRule
@@ -455,6 +456,89 @@ def test_sockets_rule_scoped_to_transport_subsystems(tmp_path):
     assert findings == []
 
 
+# ------------------------------------------------------- hot-loop-emit
+HOT_LOOP_EMIT_RED = """
+    @register_algorithm(name="fake")
+    def main(dist, cfg):
+        while policy_step < total_steps:
+            train(params)
+            telem.emit({"event": "metrics", "step": policy_step})
+"""
+
+
+def test_hot_loop_emit_red(tmp_path):
+    findings, f = _lint(tmp_path, HOT_LOOP_EMIT_RED, HotLoopEmitRule())
+    assert len(findings) == 1
+    assert findings[0].rule_id == "hot-loop-emit"
+    assert findings[0].path == str(f) and findings[0].line == 6
+    assert "telem.emit" in findings[0].message
+
+
+def test_hot_loop_emit_red_sink_write_and_bare_emit(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        def worker_loop(sink, emit):
+            for step in range(10_000):
+                sink.write({"event": "worker", "step": step})
+                _emit(emit, {"event": "worker", "step": step})
+        """,
+        HotLoopEmitRule(),
+    )
+    assert [x.line for x in findings] == [4, 5]
+    assert "sink.write" in findings[0].message
+    assert "_emit" in findings[1].message
+
+
+def test_hot_loop_emit_green_cadence_gate(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        @register_algorithm(name="fake")
+        def main(dist, cfg):
+            while policy_step < total_steps:
+                train(params)
+                if now - last_emit >= stats_every_s:
+                    telem.emit({"event": "metrics", "step": policy_step})
+        """,
+        HotLoopEmitRule(),
+    )
+    assert findings == []
+
+
+def test_hot_loop_emit_green_outside_loop_and_cold_function(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        @register_algorithm(name="fake")
+        def main(dist, cfg):
+            telem.emit({"event": "startup"})
+            while policy_step < total_steps:
+                train(params)
+
+        def report(telem):
+            for rec in records:
+                telem.emit(rec)
+        """,
+        HotLoopEmitRule(),
+    )
+    assert findings == []
+
+
+def test_hot_loop_emit_suppression(tmp_path):
+    findings, _ = _lint(
+        tmp_path,
+        """
+        @register_algorithm(name="fake")
+        def main(dist, cfg):
+            while policy_step < total_steps:
+                telem.emit(rec)  # lint: ok[hot-loop-emit] bounded: one per respawn
+        """,
+        HotLoopEmitRule(),
+    )
+    assert findings == []
+
+
 # ------------------------------------------------------- pspec-literal
 PSPEC_RED = """
     import jax
@@ -730,8 +814,8 @@ def test_syntax_error_is_a_finding(tmp_path):
 
 # ---------------------------------------------------------------- repo-wide
 def test_repo_lints_clean():
-    """Tier-1 invariant: the whole package passes all six rules with zero
-    unsuppressed findings (ISSUE 9 acceptance)."""
+    """Tier-1 invariant: the whole package passes every registered rule
+    with zero unsuppressed findings (ISSUE 9 acceptance)."""
     findings = run_paths([REPO / "sheeprl_tpu"], all_rules())
     assert findings == [], "\n".join(f.render() for f in findings)
 
@@ -789,6 +873,7 @@ RED_BY_RULE = {
     ),
     "thread-shared-state": ("engine/snippet.py", THREADS_RED, 14),
     "socket-timeout": ("fleet/snippet.py", SOCKETS_RED, 8),
+    "hot-loop-emit": ("snippet.py", HOT_LOOP_EMIT_RED, 6),
     "pspec-literal": ("algos/snippet.py", PSPEC_RED, 6),
     "telemetry-schema-drift": (
         "snippet.py",

@@ -307,6 +307,12 @@ class _SupStub:
     def queue_depth_max(self):
         return 0
 
+    def telem_dropped(self):
+        return 0
+
+    def drain_telem(self):
+        return []
+
     def shutdown(self, timeout=None):
         return {0: [], 1: []}
 

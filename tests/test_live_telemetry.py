@@ -1,0 +1,565 @@
+"""The live telemetry plane: relay sinks, the central LiveAggregator,
+SLO burn alerts, the roster check and the `top` surfaces — plus THE
+acceptance run: a live 2-worker socket fleet with one REMOTE-attached
+worker whose events arrive over the in-band relay, visible in /live and
+/metrics, with zero relay drops and a ledger bit-identical to the
+overlap engine (the relay observes the run without perturbing it)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import types
+import urllib.request
+from pathlib import Path
+
+import yaml
+
+from sheeprl_tpu.config import Config
+from sheeprl_tpu.diag.aggregator import LiveAggregator, binding_stage_for_events
+from sheeprl_tpu.diag.doctor import diagnose
+from sheeprl_tpu.diag.prometheus import Registry
+from sheeprl_tpu.diag.trace import missing_streams
+from sheeprl_tpu.telemetry.relay import RelaySink, TeeSink
+from sheeprl_tpu.telemetry.schema import validate_event, validate_jsonl
+
+
+class _ListSink:
+    """Minimal JsonlSink stand-in: records writes, tracks close."""
+
+    def __init__(self):
+        self.recs = []
+        self.closed = False
+        self.path = "mem://"
+
+    def write(self, rec):
+        self.recs.append(rec)
+
+    def close(self):
+        self.closed = True
+
+
+# ---------------------------------------------------------------------------
+# RelaySink: bounded, sampled, drop-counted, never blocking
+# ---------------------------------------------------------------------------
+def test_relay_sink_batches_events_and_reports_schema_valid_stats():
+    sent = []
+    sink = RelaySink(lambda b: sent.append(b) or True, role="worker", index=3)
+    for i in range(5):
+        sink.write({"event": "net", "action": "connect", "seq": i})
+    assert sink.flush() == 5
+    assert len(sent) == 1
+    batch = sent[0]
+    assert batch["role"] == "worker" and batch["index"] == 3
+    assert [e["seq"] for e in batch["events"]] == list(range(5))
+    assert batch["dropped"] == 0
+    rec = sink.stats_record()
+    assert validate_event(rec) == []
+    assert rec["sent"] == 5 and rec["dropped"] == 0 and rec["batches"] == 1
+
+
+def test_relay_sink_overflow_and_refused_sends_count_drops_never_raise():
+    sink = RelaySink(lambda b: True, role="worker", max_buffer=4)
+    for i in range(10):
+        sink.write({"event": "net", "action": "connect", "seq": i})
+    assert sink.dropped == 6  # bounded buffer: overflow counted, not buffered
+    # a refused batch counts its events as dropped — never retried, never
+    # re-buffered (the local file is the durable copy)
+    refused = RelaySink(lambda b: False, role="worker")
+    refused.write({"event": "net", "action": "connect"})
+    assert refused.flush() == 0
+    assert refused.dropped == 1 and refused.sent == 0
+    # a send callable that RAISES is the same as one that refuses
+    def boom(batch):
+        raise OSError("transport gone")
+
+    angry = RelaySink(boom, role="worker")
+    angry.write({"event": "net", "action": "connect"})
+    angry.flush()
+    assert angry.dropped == 1
+
+
+def test_relay_sink_samples_high_rate_events_only():
+    sink = RelaySink(lambda b: True, role="worker", sample=0.25, max_buffer=4096)
+    for _ in range(100):
+        sink.write({"event": "trace_span"})
+    spans_kept = len(sink._buf)
+    assert spans_kept == 25  # deterministic 1-in-4 counter sampling
+    for _ in range(10):  # low-rate events (incidents, intervals) always relay
+        sink.write({"event": "fleet", "action": "interval"})
+    assert len(sink._buf) == spans_kept + 10
+
+
+def test_relay_sink_size_caps_each_flush_batch():
+    sent = []
+    sink = RelaySink(
+        lambda b: sent.append(b) or True, role="worker", max_batch_bytes=2048, max_buffer=4096
+    )
+    for i in range(30):
+        sink.write({"event": "net", "action": "connect", "detail": "x" * 120, "seq": i})
+    assert sink.flush() == 30
+    assert len(sent) > 1  # split into multiple size-capped batches
+    assert sum(len(b["events"]) for b in sent) == 30
+    for b in sent:
+        assert len(json.dumps(b["events"])) <= 2048 + 256  # one-event overshoot max
+
+
+# ---------------------------------------------------------------------------
+# TeeSink: local emission unchanged, relay attachable, stats self-report
+# ---------------------------------------------------------------------------
+def test_tee_sink_local_unchanged_and_relay_stats_stay_local():
+    primary = _ListSink()
+    tee = TeeSink(primary)
+    tee.write({"event": "net", "action": "connect"})
+    assert len(primary.recs) == 1  # no relay attached: plain passthrough
+    sent = []
+    tee.attach_relay(RelaySink(lambda b: sent.append(b) or True, role="worker", flush_s=3600))
+    for i in range(60):
+        tee.write({"event": "net", "action": "connect", "seq": i})
+    tee.close()
+    assert primary.closed
+    # every record reached the local file, plus relay-stats self-reports
+    local_stats = [r for r in primary.recs if r.get("event") == "relay"]
+    assert local_stats, "relay accounting never self-reported to the local stream"
+    assert all(validate_event(r) == [] for r in local_stats)
+    # ... but the stats go to the LOCAL file only (relaying relay stats
+    # about themselves would recurse) and close() flushed the buffer
+    relayed = [e for b in sent for e in b["events"]]
+    assert all(e.get("event") != "relay" for e in relayed)
+    assert len(relayed) == 60
+
+
+def test_tee_sink_none_primary_streams_relay_only():
+    sent = []
+    tee = TeeSink(None)  # a remote worker attached without --log-dir
+    tee.attach_relay(RelaySink(lambda b: sent.append(b) or True, role="worker", index=1))
+    tee.write({"event": "net", "action": "connect"})
+    tee.close()
+    assert tee.path is None
+    assert [e["action"] for b in sent for e in b["events"]] == ["connect"]
+
+
+# ---------------------------------------------------------------------------
+# LiveAggregator: validation at the trust boundary, rollups, binding stage
+# ---------------------------------------------------------------------------
+def test_aggregator_validates_relayed_batches_and_quarantines_unknown():
+    agg = LiveAggregator({"diag": {"live": {"window_s": 60.0}}})
+    out = agg.ingest_batch(
+        {
+            "role": "worker",
+            "index": 1,
+            "events": [
+                {"event": "net", "action": "connect"},
+                {"event": "definitely_not_a_schema_event"},
+                {"event": "net"},  # missing required `action`
+            ],
+            "dropped": 2,
+        }
+    )
+    assert out == {"accepted": 1, "invalid": 2}
+    snap = agg.snapshot()
+    assert snap["streams"] == {"worker_001": 1}
+    assert snap["invalid_events"] == 2
+    assert len(snap["quarantine"]) == 2
+    assert snap["relay"]["streams"]["worker_001"]["dropped"] == 2.0
+    # garbage that isn't even a batch is counted, never fatal
+    assert agg.ingest_batch("nonsense")["invalid"] == 1
+
+
+def test_aggregator_rollups_and_binding_stage_attribution():
+    agg = LiveAggregator()
+    now = time.time()
+    agg.ingest(
+        {
+            "event": "log",
+            "step": 64,
+            "sps": 123.0,
+            "throughput": {"mfu": 0.41},
+            "xla": {"retraces": 2},
+        }
+    )
+    for i in range(5):  # the dominant stage: worker env stepping
+        agg.ingest(
+            {
+                "event": "trace_span",
+                "name": "env_step",
+                "role": "worker",
+                "trace_id": "t0",
+                "span_id": f"w{i}",
+                "t_start": now,
+                "t_end": now + 0.2,
+                "dur_ms": 200.0,
+            },
+            stream="worker_000",
+        )
+    agg.ingest(
+        {
+            "event": "trace_span",
+            "name": "train",
+            "role": "learner",
+            "trace_id": "t0",
+            "span_id": "l0",
+            "t_start": now,
+            "t_end": now + 0.01,
+            "dur_ms": 10.0,
+        }
+    )
+    snap = agg.snapshot()
+    assert snap["sps"] == 123.0 and snap["mfu"] == 0.41 and snap["retraces"] == 2
+    assert snap["binding_stage"] == "worker/env_step"
+    stage = snap["stages"]["worker/env_step"]
+    assert stage["count"] == 5 and stage["p50_ms"] == 200.0 and stage["total_ms"] == 1000.0
+    assert snap["streams"] == {"main": 2, "worker_000": 5}
+    # the offline helper agrees with the live verdict on the same events
+    assert binding_stage_for_events(
+        [rec for _, rec in agg._events]
+    ) == "worker/env_step"
+
+
+def test_slo_breach_fires_live_alert_and_doctor_finds_it_later(tmp_path):
+    emitted = []
+    cfg = {
+        "diag": {
+            "live": {
+                # eval cadence pushed out of the way: the test drives
+                # evaluation ticks explicitly via evaluate()
+                "eval_s": 3600.0,
+                "slo": [
+                    {"name": "sps_floor", "metric": "sps", "min": 500.0, "severity": "critical"}
+                ],
+            }
+        }
+    }
+    reg = Registry()
+    agg = LiveAggregator(cfg, emit=emitted.append, registry=reg)
+    # the injected breach: the very first ingest evaluates immediately
+    agg.ingest({"event": "log", "step": 32, "sps": 50.0})
+    assert [a["state"] for a in emitted] == ["firing"]
+    assert validate_event(emitted[0]) == []  # schema'd alert event
+    assert emitted[0]["value"] == 50.0 and emitted[0]["threshold"] == 500.0
+    snap = agg.snapshot()
+    assert [a["name"] for a in snap["alerts"]] == ["sps_floor"]
+    # mirrored into Prometheus: the alert counter + burn gauge
+    rendered = reg.render()
+    assert 'sheeprl_slo_alerts_total{rule="sps_floor"} 1' in rendered
+    assert 'sheeprl_slo_burn{rule="sps_floor"}' in rendered
+    # recovery resolves (and emits the transition, once)
+    agg.ingest({"event": "log", "step": 64, "sps": 900.0})
+    assert [a["state"] for a in agg.evaluate()] == ["resolved"]
+    assert agg.snapshot()["alerts"] == []
+
+    # the recorded stream: doctor surfaces the breach post-hoc
+    stream = [
+        {"event": "startup", "platform": "cpu", "device_kind": "cpu", "devices": 1, "rank": 0},
+        {"event": "log", "step": 32, "sps": 50.0, "xla": {"retraces": 0}},
+        emitted[0],
+        {"event": "shutdown", "step": 64},
+    ]
+    run_dir = tmp_path / "slo_run"
+    run_dir.mkdir()
+    with open(run_dir / "telemetry.jsonl", "w") as fh:
+        for rec in stream:
+            fh.write(json.dumps(rec) + "\n")
+    report = diagnose(run_dir)
+    finding = next(f for f in report["findings"] if f["code"] == "slo_alert")
+    assert finding["severity"] == "critical"
+    assert "sps_floor" in finding["detail"]
+    assert report["healthy"] is False
+
+
+def test_prometheus_per_metric_bucket_overrides():
+    reg = Registry()
+    reg.set_bucket_overrides({"step_time_seconds_hist": [0.005, 0.05, 0.5]})
+    reg.observe_event(
+        {"event": "log", "step": 1, "sps": 10.0, "interval_steps": 10, "interval_seconds": 1.0}
+    )
+    out = reg.render()
+    assert 'le="0.005"' in out and 'le="0.05"' in out and 'le="0.5"' in out
+    # the 0.1 s/step observation lands above 0.05, below 0.5
+    assert 'sheeprl_step_time_seconds_hist_bucket{le="0.05"} 0' in out
+    assert 'sheeprl_step_time_seconds_hist_bucket{le="0.5"} 1' in out
+    # the prefixed spelling of the family name works too
+    reg2 = Registry()
+    reg2.set_bucket_overrides({"sheeprl_step_time_seconds_hist": [1.0, 2.0]})
+    reg2.observe_event(
+        {"event": "log", "step": 1, "sps": 10.0, "interval_steps": 10, "interval_seconds": 1.0}
+    )
+    assert 'le="2"' in reg2.render()
+
+
+# ---------------------------------------------------------------------------
+# the roster check: streams the config promises but the run dir lacks
+# ---------------------------------------------------------------------------
+def test_missing_streams_roster_excludes_remote_slots():
+    cfg = Config({"algo": {"fleet": {"workers": 2}}, "fleet": {"net": {"remote_workers": []}}})
+    miss = missing_streams(cfg, ["main", "worker_000"])
+    assert [m["stream"] for m in miss] == ["worker_001"]
+    # a slot the config marks remote is relay-only: no local file expected
+    remote = Config({"algo": {"fleet": {"workers": 2}}, "fleet": {"net": {"remote_workers": [1]}}})
+    assert missing_streams(remote, ["main", "worker_000"]) == []
+    # the replica roster only applies to gateway run dirs
+    gw = Config({"gateway": {"replicas": 2}})
+    assert missing_streams(gw, ["main"]) == []
+    assert [m["stream"] for m in missing_streams(gw, ["main", "gateway", "replica_000"])] == [
+        "replica_001"
+    ]
+
+
+def test_doctor_missing_stream_finding_red_then_green(tmp_path):
+    run_dir = tmp_path / "roster_run"
+    run_dir.mkdir()
+    with open(run_dir / "telemetry.jsonl", "w") as fh:
+        for rec in (
+            {"event": "startup", "platform": "cpu", "device_kind": "cpu", "devices": 1, "rank": 0},
+            {"event": "shutdown", "step": 64},
+        ):
+            fh.write(json.dumps(rec) + "\n")
+    w0 = run_dir / "workers" / "worker_000"
+    w0.mkdir(parents=True)
+    with open(w0 / "telemetry.jsonl", "w") as fh:
+        fh.write(json.dumps({"event": "net", "action": "connect"}) + "\n")
+    with open(run_dir / "config.yaml", "w") as fh:
+        yaml.safe_dump({"algo": {"fleet": {"workers": 2}}}, fh)
+    report = diagnose(run_dir)
+    finding = next(f for f in report["findings"] if f["code"] == "missing_stream")
+    assert "worker_001" in finding["detail"]
+    assert finding["data"]["missing"][0]["stream"] == "worker_001"
+    # green: the config says slot 1 is remote — relay-only, roster-exempt
+    with open(run_dir / "config.yaml", "w") as fh:
+        yaml.safe_dump(
+            {"algo": {"fleet": {"workers": 2}}, "fleet": {"net": {"remote_workers": [1]}}}, fh
+        )
+    report = diagnose(run_dir)
+    assert not [f for f in report["findings"] if f["code"] == "missing_stream"]
+
+
+# ---------------------------------------------------------------------------
+# `sheeprl_tpu top`: argv parsing + snapshot rendering
+# ---------------------------------------------------------------------------
+def test_top_parse_and_render():
+    from sheeprl_tpu.diag.live import parse_top_argv, render_snapshot
+
+    run_dir, opts = parse_top_argv(["run_dir=logs/x", "once=true", "refresh_s=5"])
+    assert run_dir == "logs/x" and opts["once"] is True and opts["refresh_s"] == 5.0
+    text = render_snapshot(
+        {
+            "source": "live",
+            "window_s": 60.0,
+            "events_in_window": 42,
+            "sps": 1234.0,
+            "mfu": 0.41,
+            "binding_stage": "worker/env_step",
+            "alerts": [
+                {"name": "sps_floor", "metric": "sps", "value": 50.0, "burn": 1.0, "severity": "critical"}
+            ],
+            "streams": {"main": 30, "worker_001": 12},
+            "relay": {"sent": 12, "dropped": 0, "streams": {"worker_001": {"sent": 12}}},
+            "stages": {"worker/env_step": {"count": 5, "p50_ms": 200.0, "p95_ms": 210.0, "total_ms": 1000.0}},
+        }
+    )
+    assert "binding stage: worker/env_step" in text
+    assert "1 ALERT(S) FIRING" in text and "sps_floor" in text
+    assert "worker_001:12" in text
+    assert "relay: 12 sent, 0 dropped" in text
+    assert "worker/env_step" in text
+
+
+# ---------------------------------------------------------------------------
+# e2e: THE acceptance run — live 2-worker socket fleet, worker 1 attached
+# from a separate process over the relay, /live + /metrics live, ledger
+# bit-identical to the overlap engine with the relay on
+# ---------------------------------------------------------------------------
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _sac_args(run_name, total=512, extra=()):
+    return [
+        "exp=sac",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "metric.log_level=1",
+        f"algo.total_steps={total}",
+        "algo.learning_starts=16",
+        "algo.per_rank_batch_size=4",
+        "algo.hidden_size=8",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.run_test=False",
+        "buffer.size=4096",
+        "buffer.memmap=False",
+        "buffer.checkpoint=True",
+        "checkpoint.every=0",
+        "checkpoint.save_last=True",
+        "model_manager.disabled=True",
+        "seed=3",
+        f"run_name={run_name}",
+        "fleet.backoff_s=0.05",
+        "fleet.stats_every_s=0.5",
+    ] + list(extra)
+
+
+def _final_ckpt(run_name):
+    from sheeprl_tpu.utils.checkpoint import CheckpointManager
+
+    base = Path("logs/runs/sac/continuous_dummy") / run_name
+    cks = sorted(
+        (base / "version_0" / "checkpoint").glob("ckpt_*.ckpt"),
+        key=lambda p: int(p.stem.split("_")[1]),
+    )
+    assert cks, f"no checkpoint under {base}"
+    return CheckpointManager.load(cks[-1]), base
+
+
+def test_relay_live_fleet_with_remote_worker_ledger_parity(monkeypatch):
+    """512 SAC steps through a 2-worker SOCKET fleet where worker 1 runs in
+    a SEPARATE process attached via `python -m sheeprl_tpu.fleet.remote`
+    with no local log dir — its only telemetry path is the in-band relay.
+    While the run is live, /live must show both workers' relayed streams
+    with zero drops and /metrics must serve; afterwards the Ratio ledger,
+    grad steps and buffer fill must be BIT-IDENTICAL to the overlap
+    engine's, and doctor must NOT flag the remote slot's absent local
+    stream (it is roster-exempt)."""
+    from sheeprl_tpu.cli import run
+    from sheeprl_tpu.fleet import supervisor as sup_mod
+
+    # pin the run token so the remote process can present it (the real flow
+    # reads it off the learner's stderr banner / `net listen` event)
+    token = "f" * 32
+    monkeypatch.setattr(
+        sup_mod,
+        "uuid",
+        types.SimpleNamespace(uuid4=lambda: types.SimpleNamespace(hex=token)),
+    )
+    fleet_port = _free_port()
+    prom_port = _free_port()
+
+    # worker 1 attaches from a separate process once the listener is up
+    attach = {}
+
+    def _attach_remote():
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                with socket.create_connection(("127.0.0.1", fleet_port), timeout=0.2):
+                    break
+            except OSError:
+                time.sleep(0.2)
+        repo_root = Path(__file__).resolve().parents[1]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(repo_root) + os.pathsep + env.get("PYTHONPATH", "")
+        attach["proc"] = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "sheeprl_tpu.fleet.remote",
+                "--connect",
+                f"127.0.0.1:{fleet_port}",
+                "--worker-id",
+                "1",
+                "--token",
+                token,
+            ],
+            cwd=str(repo_root),
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
+        )
+
+    attacher = threading.Thread(target=_attach_remote, daemon=True)
+    attacher.start()
+
+    # poll /live while the run is going: the remote worker's relayed stream
+    # must be visible IN-RUN (this is the whole point of the plane)
+    live = {"snaps": [], "metrics": ""}
+    stop = threading.Event()
+
+    def _poll_live():
+        url = f"http://127.0.0.1:{prom_port}/live"
+        murl = f"http://127.0.0.1:{prom_port}/metrics"
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(url, timeout=1) as resp:
+                    snap = json.loads(resp.read().decode())
+                if isinstance(snap, dict) and "worker_001" in (snap.get("streams") or {}):
+                    live["snaps"].append(snap)
+                    with urllib.request.urlopen(murl, timeout=1) as resp:
+                        live["metrics"] = resp.read().decode()
+            except Exception:
+                pass
+            time.sleep(0.4)
+
+    poller = threading.Thread(target=_poll_live, daemon=True)
+    poller.start()
+    try:
+        run(
+            _sac_args(
+                "relay_live_fleet",
+                extra=[
+                    "algo.fleet.workers=2",
+                    "fleet.transport=socket",
+                    f"fleet.net.port={fleet_port}",
+                    "fleet.net.remote_workers=[1]",
+                    "fleet.relay.flush_s=0.2",
+                    f"metric.telemetry.prometheus_port={prom_port}",
+                ],
+            )
+        )
+    finally:
+        stop.set()
+        poller.join(timeout=5)
+        attacher.join(timeout=5)
+        proc = attach.get("proc")
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    proc = attach.get("proc")
+    assert proc is not None, "the remote worker process was never started"
+    stderr = proc.stderr.read().decode() if proc.stderr else ""
+    assert proc.returncode == 0, f"remote worker exited {proc.returncode}: {stderr[-2000:]}"
+
+    # the live surfaces saw the relayed streams, with zero drops
+    assert live["snaps"], "/live never showed the remote worker's relayed stream"
+    snap = live["snaps"][-1]
+    assert "worker_001" in snap["streams"]  # events arrived over the relay
+    relay_streams = snap["relay"]["streams"]
+    assert "worker_000" in relay_streams and "worker_001" in relay_streams
+    assert snap["relay"]["dropped"] == 0
+    assert snap["invalid_events"] == 0  # every relayed event schema-validated
+    assert snap["events_in_window"] > 0
+    assert "sheeprl_up 1" in live["metrics"]  # /metrics federated on the same server
+
+    # the ledger: bit-identical to the overlap engine with the relay on
+    fleet_st, base = _final_ckpt("relay_live_fleet")
+    run(_sac_args("relay_live_ref", extra=["algo.overlap.enabled=True"]))
+    ref_st, _ = _final_ckpt("relay_live_ref")
+    assert fleet_st["policy_step"] == ref_st["policy_step"] == 512
+    assert fleet_st["cumulative_grad_steps"] == ref_st["cumulative_grad_steps"] > 0
+    assert fleet_st["ratio"] == ref_st["ratio"]
+    assert fleet_st["rb"]["pos"] == ref_st["rb"]["pos"]
+    assert fleet_st["rb"]["full"] == ref_st["rb"]["full"]
+
+    # relay drops stayed zero on the learner's own accounting too
+    events = [json.loads(ln) for ln in open(base / "version_0" / "telemetry.jsonl")]
+    fleet_evs = [e for e in events if e["event"] == "fleet"]
+    assert fleet_evs
+    assert all(int(e.get("relay_dropped") or 0) == 0 for e in fleet_evs)
+    assert validate_jsonl(base / "version_0" / "telemetry.jsonl") == []
+
+    # worker 0 (local) kept its durable stream; worker 1 (remote, no
+    # --log-dir) has none — and doctor knows the roster says that is FINE
+    assert (base / "version_0" / "workers" / "worker_000" / "telemetry.jsonl").is_file()
+    assert not (base / "version_0" / "workers" / "worker_001").exists()
+    report = diagnose(base / "version_0")
+    assert not [f for f in report["findings"] if f["code"] == "missing_stream"]
