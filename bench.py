@@ -225,12 +225,16 @@ def bench_dreamer_fleet(which: str) -> dict:
     workers = int(os.environ.get("BENCH_FLEET_WORKERS", 2))
     num_envs = int(os.environ.get("BENCH_FLEET_ENVS", max(4, workers)))
     # BENCH_FLEET_TRANSPORT=socket routes the same recipe over localhost TCP
-    # (fleet.transport=socket, sheeprl_tpu/fleet/net.py). The unit carries
-    # the transport so bench_compare gates socket rounds against socket
-    # rounds only — the two transports have different floors by design.
+    # (fleet.transport=socket, sheeprl_tpu/fleet/net.py);
+    # BENCH_FLEET_ACT_MODE=inference routes acting through the learner-hosted
+    # batched act service (fleet/act_service.py, the Sebulba layout). The
+    # unit carries transport, act mode AND worker count, so bench_compare
+    # gates like against like only — each topology has its own floor, and a
+    # unit with no prior trajectory is auto-skipped (noted, never failed).
     transport = os.environ.get("BENCH_FLEET_TRANSPORT", "mp")
-    unit = "env steps/sec (fleet)" if transport == "mp" else f"env steps/sec (fleet/{transport})"
-    return _timed_cli_run(
+    act_mode = os.environ.get("BENCH_FLEET_ACT_MODE", "worker")
+    unit = f"env steps/sec (fleet/{transport}/{act_mode}/w{workers})"
+    rec = _timed_cli_run(
         [
             f"exp={DREAMER_EXPS[which]}",
             "env=dummy",
@@ -242,6 +246,7 @@ def bench_dreamer_fleet(which: str) -> dict:
             f"algo.max_wall_time_s={wall_cap}",
             f"algo.fleet.workers={workers}",
             f"fleet.transport={transport}",
+            f"fleet.act_mode={act_mode}",
             f"buffer.size={steps}",
             "buffer.checkpoint=False",
             "buffer.memmap=False",
@@ -254,9 +259,43 @@ def bench_dreamer_fleet(which: str) -> dict:
         DREAMER_TOTAL_STEPS_REF,
         f"Dreamer{which.upper().replace('DV', 'V')} {steps}-step micro-bench policy SPS "
         f"(same end-to-end recipe through the {workers}-process actor fleet, "
-        f"{transport} transport)",
+        f"{transport} transport, act_mode={act_mode})",
         unit=unit,
     )
+    rec["fleet_workers"] = workers
+    rec["act_mode"] = act_mode
+    rec["transport"] = transport
+    return rec
+
+
+def bench_anakin() -> dict:
+    """The Anakin leg (sheeprl_tpu/fleet/anakin.py): policy + jax-native env
+    fused under vmap inside one jitted scan — the architecture's throughput
+    ceiling when the env itself is an array program. `vs_baseline` is the
+    ratio over the socket fleet's steady-state 11.81 env-steps/s (BENCH_r06):
+    the acceptance bar for this leg is >= 10x."""
+    from sheeprl_tpu.config import Config
+    from sheeprl_tpu.fleet.anakin import run_anakin
+
+    slots = int(os.environ.get("BENCH_ANAKIN_SLOTS", 1024))
+    chunk = int(os.environ.get("BENCH_ANAKIN_CHUNK", 256))
+    seconds = float(os.environ.get("BENCH_ANAKIN_SECONDS", 10.0))
+    cfg = Config({"seed": 5, "fleet": {"anakin": {"slots": slots, "chunk": chunk}}})
+    res = run_anakin(cfg, min_seconds=seconds)
+    baseline_sps = 11.81  # BENCH_r06 socket-fleet steady-state env-steps/s
+    return {
+        "metric": (
+            f"Anakin fused act path ({slots} vmapped env slots x {chunk}-step "
+            "jitted scan chunks, synthetic jax-native env)"
+        ),
+        "value": round(res["steps_per_s"], 2),
+        "unit": "env steps/sec (fleet/anakin)",
+        "vs_baseline": round(res["steps_per_s"] / baseline_sps, 1),
+        "elapsed_seconds": round(res["seconds"], 2),
+        "steps": res["env_steps"],
+        "slots": slots,
+        "chunk": chunk,
+    }
 
 
 def _run_subprocess_record(argv: list, budget_s: float) -> dict | None:
@@ -316,7 +355,7 @@ def _maybe_force_cpu() -> None:
 def main() -> None:
     arg = sys.argv[1] if len(sys.argv) > 1 else ""
     is_fleet_leg = arg.endswith("_fleet") and arg[: -len("_fleet")] in DREAMER_EXPS
-    if arg in RECIPE_EXPS or arg in DREAMER_EXPS or arg == "dv3_step" or is_fleet_leg:
+    if arg in RECIPE_EXPS or arg in DREAMER_EXPS or arg in ("dv3_step", "anakin") or is_fleet_leg:
         if not os.environ.get("BENCH_FORCE_CPU") and not os.environ.get("BENCH_PREFLIGHT_DONE"):
             # standalone subcommand run (the default path already preflighted
             # and marks its subprocesses with BENCH_PREFLIGHT_DONE): probe the
@@ -337,6 +376,10 @@ def main() -> None:
         _emit(bench_dreamer_e2e(arg))
     elif arg.endswith("_fleet") and arg[: -len("_fleet")] in DREAMER_EXPS:
         _emit(bench_dreamer_fleet(arg[: -len("_fleet")]))
+    elif arg == "anakin":
+        with contextlib.redirect_stdout(sys.stderr):
+            rec = bench_anakin()
+        _emit(rec)
     elif arg == "preflight":
         with contextlib.redirect_stdout(sys.stderr):
             rec = bench_preflight()

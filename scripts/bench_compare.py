@@ -32,10 +32,16 @@ Comparison rules (normalization — the trajectory is heterogeneous):
   rounds from before the sharding subsystem carry none of these fields, so
   the per-chip gates auto-skip against them;
 * **extra legs** (`extra_metrics` on a record — the compute-only dv3_step
-  leg, the fleet e2e leg `env steps/sec (fleet)`): every leg of the newest
-  record gates on its OWN unit + platform class against the best comparable
-  prior leg (searched across priors' headline AND extra legs), so a fleet
-  throughput slide is caught even though the headline unit never carried it;
+  leg, the fleet e2e legs): every leg of the newest record gates on its OWN
+  unit + platform class against the best comparable prior leg (searched
+  across priors' headline AND extra legs), so a fleet throughput slide is
+  caught even though the headline unit never carried it. Fleet legs carry
+  topology in the unit — ``env steps/sec (fleet/<transport>/<act_mode>/
+  w<workers>)`` since the batched act service landed (plus the fused
+  ``env steps/sec (fleet/anakin)`` leg) vs the bare ``env steps/sec
+  (fleet)`` / ``(fleet/socket)`` of pre-service trajectories — and a unit
+  with no comparable prior auto-skips (a note, never a failure), so the
+  first round under a new topology establishes its own baseline;
 * `SERVE_*.json` (scripts/bench_serve.py — the gateway load bench): gated
   with the **direction flag the record carries** (`direction: lower` — the
   headline value is p95 latency in ms, where UP is the regression), plus a

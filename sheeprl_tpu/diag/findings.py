@@ -776,6 +776,79 @@ def detect_cross_process_stall(tl: Timeline, cfg: Any = None) -> List[Finding]:
     ]
 
 
+def detect_act_service_starvation(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """The batched act service (fleet.act_mode=inference) is dispatching
+    mostly-empty buckets while workers spend their time parked in
+    ``act_submit``: the fleet is paying full batched-inference latency for
+    a fraction of the batching win. The classic cause is a coalescing
+    window too short for the fleet's arrival spread (requests trickle in
+    one per flush) or buckets far wider than ``workers x envs_per_worker``
+    rows ever fill."""
+    min_occupancy = float(_sel(cfg, "diag.act.min_occupancy", 0.5))
+    min_batches = int(_sel(cfg, "diag.act.min_batches", 20))
+    intervals = [
+        rec
+        for rec in tl.of("fleet")
+        if rec.get("action") == "interval" and rec.get("act_batches") is not None
+    ]
+    if not intervals:
+        return []
+    last = intervals[-1]
+    batches = int(last.get("act_batches") or 0)
+    occupancy = float(last.get("act_occupancy") or 0.0)
+    if batches < min_batches or occupancy >= min_occupancy:
+        return []
+    # starvation needs BOTH sides: empty buckets service-side AND the wait
+    # actually binding worker-side — act_submit the heaviest worker stage
+    stage_ms: Dict[str, float] = {}
+    for s in tl.of("trace_span"):
+        if s.get("role") == "worker":
+            name = str(s.get("name") or "")
+            stage_ms[name] = stage_ms.get(name, 0.0) + float(s.get("dur_ms") or 0.0)
+    submit_ms = stage_ms.get("act_submit", 0.0)
+    if submit_ms <= 0 or any(
+        v > submit_ms for k, v in stage_ms.items() if k != "act_submit"
+    ):
+        return []
+    waste = float(last.get("act_pad_waste") or 0.0)
+    steps = [int(rec.get("step") or 0) for rec in intervals]
+    return [
+        Finding(
+            code="act_service_starvation",
+            severity="warning",
+            title=(
+                f"act service starvation: bucket occupancy {occupancy:.0%} "
+                f"(< {min_occupancy:.0%}) while act_submit is the workers' "
+                f"binding stage"
+            ),
+            detail=(
+                f"{batches} act batches dispatched at {occupancy:.0%} mean "
+                f"occupancy (pad waste {waste:.0%}); worker-side act_submit "
+                f"accounts for {submit_ms:.0f} ms of span time — more than any "
+                f"other worker stage. Workers are waiting on an inference "
+                f"service that is acting on mostly-padding buckets."
+            ),
+            remediation=(
+                "Raise fleet.act.max_wait_ms so the coalescing window spans the "
+                "fleet's request arrival spread (each worker ships envs_per_worker "
+                "rows per slice), or shrink fleet.act.buckets toward "
+                "workers x envs_per_worker so full buckets are reachable. High "
+                "act_pad_waste with healthy occupancy instead means the bucket "
+                "grid is too coarse — add intermediate bucket sizes."
+            ),
+            step_first=min(steps),
+            step_last=max(steps),
+            data={
+                "occupancy": occupancy,
+                "pad_waste": waste,
+                "batches": batches,
+                "act_submit_ms": round(submit_ms, 2),
+                "worker_stage_ms": {k: round(v, 2) for k, v in sorted(stage_ms.items())},
+            },
+        )
+    ]
+
+
 def detect_flywheel_staleness(tl: Timeline, cfg: Any = None) -> List[Finding]:
     """The data flywheel is falling behind: ingest passes whose FRESHEST
     sample lags the serving ``params_version`` by at least
@@ -976,6 +1049,7 @@ DETECTORS: List[Callable[[Timeline, Any], List[Finding]]] = [
     detect_broker_lag,
     detect_gateway_shedding,
     detect_cross_process_stall,
+    detect_act_service_starvation,
     detect_flywheel_staleness,
     detect_replicated_giant,
     detect_slo_alerts,

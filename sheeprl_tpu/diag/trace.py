@@ -57,8 +57,10 @@ DEFAULT_TOP_K = 10
 _ROUND_ROLES = {"worker", "learner", "player"}
 _REQUEST_ROLES = {"gateway", "replica", "client"}
 # the stages that are *waits* (queue/transport/backpressure) rather than
-# work — what cross_process_stall attributes a stalled path to
-WAIT_STAGES = {"queue_wait", "batch_queue", "admission", "route"}
+# work — what cross_process_stall attributes a stalled path to. act_submit
+# is the worker-side wait on the batched act service (submit → response):
+# its learner-side work twin is act_infer, which stays a work stage
+WAIT_STAGES = {"queue_wait", "batch_queue", "admission", "route", "act_submit"}
 # spans that anchor completeness: one learner_apply == one applied packet,
 # one gateway forward == one acked (traced) request
 _ROUND_ANCHOR = "learner_apply"

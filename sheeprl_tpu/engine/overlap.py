@@ -5,7 +5,12 @@ The serial loops interleave env interaction and gradient bursts in one
 thread, so the device idles while Python steps environments, and the
 player's jitted ``act`` dispatches queue behind the scanned train burst on
 the same device stream. The fix is the Podracer/Sebulba split (arXiv:
-2104.06272), re-derived for a single-controller JAX process:
+2104.06272), re-derived for a single-controller JAX process. (The
+multi-PROCESS twin of this split lives in the actor fleet: under
+``fleet.act_mode=inference`` the workers ship obs batches to the
+learner-hosted batched act service — :mod:`sheeprl_tpu.fleet.act_service` —
+and for jax-native envs :mod:`sheeprl_tpu.fleet.anakin` fuses env + policy
+under one jitted scan, the Anakin corner of the same paper.)
 
 * the **player thread** steps the envs, acting against the existing
   :class:`~sheeprl_tpu.parallel.placement.ParamMirror` snapshot — on a

@@ -83,6 +83,7 @@ class FleetEngine:
         stats_every_s: float = 5.0,
         shutdown_drain_s: float = 10.0,
         transport: str = "mp",
+        act_mode: str = "worker",
         net: Any = None,
         remote_workers: Any = None,
         total_steps: int = 0,
@@ -107,6 +108,8 @@ class FleetEngine:
         self.stats_every_s = float(stats_every_s)
         self.shutdown_drain_s = float(shutdown_drain_s)
         self.transport = str(transport)
+        self.act_mode = str(act_mode)
+        self.act: Optional[Any] = None  # ActService under act_mode=inference
         self.net = net
         self.remote_workers = list(remote_workers or [])
         self.total_steps = int(total_steps)
@@ -188,6 +191,7 @@ class FleetEngine:
                 opt("fleet.shutdown_drain_s", opt("fleet.drain_timeout_s", 10.0))
             ),
             transport=str(opt("fleet.transport", "mp")),
+            act_mode=str(opt("fleet.act_mode", "worker")),
             net=_net_from_cfg(cfg, opt),
             remote_workers=[int(w) for w in (opt("fleet.net.remote_workers", []) or [])],
             total_steps=total_steps,
@@ -251,6 +255,23 @@ class FleetEngine:
         self.sup.progress_step = self.acked_steps  # resume: seed lifetimes
         self.sup.start()
         self._pending = {h.worker_id: deque() for h in self.sup.handles}
+        if self.act_mode == "inference":
+            # Sebulba: one learner-hosted batched act service for the whole
+            # fleet; workers run with fleet.act_mode=inference (read from the
+            # same cfg that rides the spawn spec) and ship obs batches here
+            from .act_service import ActService
+
+            core_name = program.rsplit(":", 1)[-1]
+            if core_name.endswith("_program"):
+                core_name = core_name[: -len("_program")]
+            self.act = ActService(
+                cfg, core_name, telem=self.telem, trace=self.trace_spans
+            ).start()
+            listener = getattr(self.sup, "listener", None)
+            if listener is not None:
+                listener.set_act_handler(self.act.wire_handler)
+            else:
+                self.act.attach_mp(self.sup)
         return self
 
     def publish(self, params: Any) -> int:
@@ -261,7 +282,14 @@ class FleetEngine:
             return 0
         import jax
 
-        return self.sup.publish(jax.tree.map(lambda x: np.asarray(x), params))
+        params_np = jax.tree.map(lambda x: np.asarray(x), params)
+        if self.act is not None:
+            # swap the service BEFORE the broadcast that versions the ledger:
+            # by the time any worker learns of publication N the service
+            # already acts with N — staleness accounting stays bit-identical
+            # to the per-worker act path
+            self.act.swap_params(params_np, self.sup.pub_seq + 1)
+        return self.sup.publish(params_np)
 
     # -- the merge ---------------------------------------------------------
     def _should_stop(self) -> bool:
@@ -556,6 +584,9 @@ class FleetEngine:
         dropped = self.sup.telem_dropped()
         if dropped:
             rec["relay_dropped"] = int(dropped)
+        if self.act is not None:
+            rec["act_mode"] = "inference"
+            rec.update(self.act.snapshot())
         try:
             self.telem.emit(rec)
         except Exception:
@@ -574,6 +605,8 @@ class FleetEngine:
         self._stopped = True
         active = self.sup.active_ids()
         leftovers = self.sup.shutdown(timeout=self.shutdown_drain_s)
+        if self.act is not None:
+            self.act.stop()
         for wid, frames in leftovers.items():
             for frame in frames:
                 try:

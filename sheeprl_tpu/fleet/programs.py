@@ -24,11 +24,72 @@ Programs expose:
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
 __all__ = ["dreamer_v3_program", "merge_ppo_round", "ppo_program", "sac_program"]
+
+
+def _act_mode(cfg: Any) -> str:
+    sel = cfg.select if hasattr(cfg, "select") else (lambda p, d=None: d)
+    return str(sel("fleet.act_mode", "worker") or "worker")
+
+
+def _act_timeout(cfg: Any) -> float:
+    sel = cfg.select if hasattr(cfg, "select") else (lambda p, d=None: d)
+    v = sel("fleet.act.timeout_s", None)
+    return float(30.0 if v is None else v)
+
+
+def _remote_act(program: Any, req: Dict[str, Any]) -> Dict[str, Any]:
+    """Ship one act request through the worker's channel (Sebulba mode) and
+    block for the batched response, emitting the `act_submit` span the trace
+    merger pairs with the service's `act_infer`. The channel is injected by
+    the worker loop (``act_transport`` / ``act_identity``); request ids are
+    a per-incarnation counter, so the service's idempotency cache can tell a
+    retry from a new request."""
+    from ..telemetry import tracing
+
+    transport = getattr(program, "act_transport", None)
+    identity = getattr(program, "act_identity", None)
+    if transport is None or identity is None:
+        raise RuntimeError(
+            "fleet.act_mode=inference requires the worker loop's act transport "
+            "(program ran outside fleet_worker_loop?)"
+        )
+    program._act_seq = int(getattr(program, "_act_seq", 0)) + 1
+    ctx = tracing.TraceContext(tracing.new_trace_id(), tracing.new_span_id())
+    req = dict(req)
+    req["worker_id"] = int(identity[0])
+    req["incarnation"] = int(identity[1])
+    req["req_id"] = int(program._act_seq)
+    req["trace"] = (ctx.trace_id, ctx.span_id)
+    t0 = time.time()
+    resp = transport.act_request(
+        req,
+        timeout_s=float(getattr(program, "act_timeout_s", 30.0)),
+        beat=getattr(program, "beat", None),
+    )
+    t1 = time.time()
+    emit = getattr(program, "trace_emit", None)
+    if emit is not None:
+        emit(  # lint: ok[hot-loop-emit] — one act_submit span per slice (same cadence as env_step)
+            tracing.span_record(
+                "act_submit",
+                "worker",
+                ctx,
+                t0,
+                t1,
+                worker=req["worker_id"],
+                seq=req["req_id"],
+                version=int(resp.get("version", 0) or 0),
+            )
+        )
+    if resp.get("error"):
+        raise RuntimeError(f"act service error: {resp['error']}")
+    return resp
 
 
 def _slice_cfg(cfg: Any, epw: int) -> Any:
@@ -56,9 +117,9 @@ def _slice_seed(cfg: Any, worker_id: int, epw: int) -> int:
 def sac_program(cfg: Any, worker_id: int, num_workers: int) -> Any:
     import jax
 
-    from ..algos.sac.agent import SACActor, sample_actions
     from ..algos.sac.utils import flatten_obs
     from ..utils.env import episode_stats, vectorize
+    from .act_core import build_act_core, row_keys
 
     class _SacProgram:
         sync_params = False
@@ -74,20 +135,19 @@ def sac_program(cfg: Any, worker_id: int, num_workers: int) -> Any:
             self.act_dim = int(np.prod(self.action_space.shape))
             self.validate = bool(cfg.buffer.validate_args)
             self.learning_starts = int(cfg.algo.learning_starts) if not cfg.dry_run else 0
-            actor = SACActor(
-                action_dim=self.act_dim,
-                hidden_size=cfg.algo.actor.hidden_size,
-                action_low=self.action_space.low.tolist(),
-                action_high=self.action_space.high.tolist(),
+            self.act_mode = _act_mode(cfg)
+            self.act_timeout_s = _act_timeout(cfg)
+            # worker mode steps the shared pad-invariant act core locally;
+            # inference mode ships (obs, base key) and the learner-side
+            # service steps the SAME core — identical row math either way
+            self._core = (
+                None
+                if self.act_mode == "inference"
+                else build_act_core(
+                    "sac", cfg, self.envs.single_observation_space, self.action_space
+                )
             )
-
-            @jax.jit
-            def act(actor_params, obs, key):
-                mean, log_std = actor.apply({"params": actor_params}, obs)
-                actions, _ = sample_actions(actor, mean, log_std, key)
-                return actions
-
-            self._act = act
+            self._act_params: Any = None
             self._episode_stats = episode_stats
             self._flatten = flatten_obs
             self.key = jax.random.PRNGKey(int(cfg.seed) + 977 * (worker_id + 1))
@@ -98,6 +158,8 @@ def sac_program(cfg: Any, worker_id: int, num_workers: int) -> Any:
 
         def set_params(self, params_np: Any, version: int) -> None:
             self.params = params_np
+            if self._core is not None:
+                self._act_params = self._core.extract_params(params_np)
 
         def step(self, sink: Any) -> Tuple[int, None]:
             import jax
@@ -107,10 +169,16 @@ def sac_program(cfg: Any, worker_id: int, num_workers: int) -> Any:
             # the same per-slice count when rounds are full-strength
             if self.params is None or self.lifetime * self.num_workers <= self.learning_starts:
                 env_actions = np.stack([self.action_space.sample() for _ in range(epw)])
+            elif self.act_mode == "inference":
+                self.key, k = jax.random.split(self.key)
+                resp = _remote_act(
+                    self, {"n": epw, "obs": self.obs_vec, "key": np.asarray(k)}
+                )
+                env_actions = np.asarray(resp["actions"]).reshape(epw, self.act_dim)
             else:
                 self.key, k = jax.random.split(self.key)
                 env_actions = np.asarray(
-                    self._act(self.params["actor"], self.obs_vec, k)
+                    self._core.act(self._act_params, self.obs_vec, row_keys(k, epw))[0]
                 ).reshape(epw, self.act_dim)
             next_obs, rewards, terminated, truncated, info = self.envs.step(env_actions)
             self.lifetime += epw
@@ -150,11 +218,9 @@ def dreamer_v3_program(cfg: Any, worker_id: int, num_workers: int) -> Any:
     import gymnasium as gym
     import jax
 
-    from ..algos.dreamer_v3.agent import build_agent
-    from ..algos.dreamer_v3.dreamer_v3 import make_player
     from ..algos.dreamer_v3.utils import extract_masks, prepare_obs
-    from ..parallel.mesh import Distributed
     from ..utils.env import episode_stats, patch_restarted_envs, vectorize
+    from .act_core import build_act_core, row_keys
 
     class _DreamerProgram:
         sync_params = False
@@ -186,17 +252,21 @@ def dreamer_v3_program(cfg: Any, worker_id: int, num_workers: int) -> Any:
             self.validate = bool(cfg.buffer.validate_args)
             self.learning_starts = int(cfg.algo.learning_starts) if not cfg.dry_run else 0
             self.clip_rewards = bool(cfg.env.clip_rewards)
+            self.act_mode = _act_mode(cfg)
+            self.act_timeout_s = _act_timeout(cfg)
 
-            # module defs only — the init params are discarded; the real
-            # {wm, actor} snapshot arrives via the first publication
-            dist = Distributed(devices=1, accelerator="cpu")
-            wm, actor, _critic, _params = build_agent(
-                dist, cfg, obs_space, self.actions_dim, self.is_continuous,
-                jax.random.PRNGKey(0), None,
+            # worker mode builds the shared pad-invariant act core (world
+            # model + actor on host CPU); inference mode stays light — the
+            # learner-side service owns the core AND this worker's (h, z, a)
+            # latents, keyed (worker_id, env_slot). The worker only tracks
+            # which slots need a latent reset on the next request.
+            self._core = (
+                None
+                if self.act_mode == "inference"
+                else build_act_core("dreamer_v3", cfg, obs_space, action_space)
             )
-            self.player_init, self.player_step = make_player(
-                wm, actor, cfg, self.actions_dim, self.is_continuous, self.epw
-            )
+            self._act_params: Any = None
+            self._pending_reset = np.ones((self.epw,), bool)
             self._prepare_obs = prepare_obs
             self._extract_masks = extract_masks
             self._episode_stats = episode_stats
@@ -221,8 +291,10 @@ def dreamer_v3_program(cfg: Any, worker_id: int, num_workers: int) -> Any:
 
         def set_params(self, params_np: Any, version: int) -> None:
             self.params = params_np
-            if self.player_state is None:
-                self.player_state = self.player_init(params_np)
+            if self._core is not None:
+                self._act_params = self._core.extract_params(params_np)
+                if self.player_state is None:
+                    self.player_state = self._core.init_state(self._act_params, self.epw)
 
         def step(self, sink: Any) -> Tuple[int, None]:
             import jax
@@ -231,8 +303,8 @@ def dreamer_v3_program(cfg: Any, worker_id: int, num_workers: int) -> Any:
             step_data = self.step_data
             if (
                 self.params is None
-                or self.player_state is None
                 or self.lifetime * self.num_workers <= self.learning_starts
+                or (self.act_mode != "inference" and self.player_state is None)
             ):
                 actions_env = np.stack([self.action_space.sample() for _ in range(epw)])
                 if self.is_continuous:
@@ -243,11 +315,32 @@ def dreamer_v3_program(cfg: Any, worker_id: int, num_workers: int) -> Any:
                     for j, adim in enumerate(self.actions_dim):
                         oh.append(np.eye(adim, dtype=np.float32)[acts2d[:, j]])
                     actions_np = np.concatenate(oh, axis=-1)
+            elif self.act_mode == "inference":
+                host_obs = self._prepare_obs(self.obs, self.cnn_keys, self.mlp_keys, epw)
+                self.key, k = jax.random.split(self.key)
+                req: Dict[str, Any] = {"n": epw, "obs": host_obs, "key": np.asarray(k)}
+                mask = self._extract_masks(self.obs, epw)
+                if mask is not None:
+                    req["mask"] = mask
+                if self._pending_reset.any():
+                    req["reset"] = self._pending_reset.copy()
+                resp = _remote_act(self, req)
+                # only clear after a successful round trip: an act failure
+                # crashes this incarnation, and the respawn must re-init its
+                # service-side latents from an all-ones reset mask
+                self._pending_reset[:] = False
+                actions_np = np.asarray(resp["actions_cat"])
+                actions_env = np.asarray(resp["actions"])
+                if self.is_continuous:
+                    actions_env = actions_env.reshape(epw, -1)
+                elif not self.is_multidiscrete:
+                    actions_env = actions_env.reshape(epw)
             else:
                 host_obs = self._prepare_obs(self.obs, self.cnn_keys, self.mlp_keys, epw)
-                env_actions, actions_cat, self.player_state, self.key = self.player_step(
-                    self.params, host_obs, self.player_state, self.key,
-                    action_mask=self._extract_masks(self.obs, epw),
+                self.key, k = jax.random.split(self.key)
+                env_actions, actions_cat, self.player_state = self._core.act(
+                    self._act_params, host_obs, row_keys(k, epw),
+                    state=self.player_state, mask=self._extract_masks(self.obs, epw),
                 )
                 actions_np = np.asarray(actions_cat)
                 actions_env = np.asarray(env_actions)
@@ -282,8 +375,13 @@ def dreamer_v3_program(cfg: Any, worker_id: int, num_workers: int) -> Any:
             step_data["rewards"] = np.tanh(rew) if self.clip_rewards else rew
 
             restarted = self._patch_restarted(info, dones, sink, step_data)
-            if restarted is not None and self.player_state is not None:
-                self.player_state = self.player_init(self.params, restarted, self.player_state)
+            if restarted is not None:
+                if self.act_mode == "inference":
+                    self._pending_reset |= np.asarray(restarted, bool).reshape(epw)
+                elif self.player_state is not None:
+                    self.player_state = self._core.reset_state(
+                        self._act_params, restarted, self.player_state
+                    )
 
             dones_idxes = np.nonzero(dones)[0].tolist()
             if dones_idxes:
@@ -300,10 +398,14 @@ def dreamer_v3_program(cfg: Any, worker_id: int, num_workers: int) -> Any:
                 step_data["terminated"][:, dones_idxes] = 0
                 step_data["truncated"][:, dones_idxes] = 0
                 step_data["is_first"][:, dones_idxes] = 1
-                if self.player_state is not None:
+                if self.act_mode == "inference":
+                    self._pending_reset[dones_idxes] = True
+                elif self.player_state is not None:
                     mask = np.zeros((epw,), bool)
                     mask[dones_idxes] = True
-                    self.player_state = self.player_init(self.params, mask, self.player_state)
+                    self.player_state = self._core.reset_state(
+                        self._act_params, mask, self.player_state
+                    )
 
             self.obs = next_obs
             return epw, None

@@ -39,6 +39,7 @@ CRC check whether the frame crossed a queue or a network.
 from __future__ import annotations
 
 import pickle
+import time
 import zlib
 from typing import Any, List, NamedTuple, Optional, Tuple
 
@@ -85,6 +86,13 @@ class FleetPacket(NamedTuple):
 
 class TornPacketError(RuntimeError):
     """A frame failed CRC/unpickle validation — corrupted in flight."""
+
+
+class ChannelStopped(RuntimeError):
+    """The channel was stopped (learner-initiated shutdown) while a worker
+    was parked on it — a clean-exit signal, not a fault: ``worker_entry``
+    treats it like KeyboardInterrupt so a wall-capped stop doesn't print N
+    act-request tracebacks and count N worker deaths."""
 
 
 def encode_packet(pkt: FleetPacket) -> Tuple[int, int, int, int, int, int, bytes]:
@@ -145,11 +153,54 @@ class WorkerChannel:
         # bounded — the relay is advisory, a full queue means the batch is
         # dropped worker-side (counted there), never backpressure
         self.telem = ctx.Queue(maxsize=64)
+        # batched-inference acting (fleet.act_mode=inference): the worker
+        # ships obs-batch requests on act_req and blocks on act_resp for its
+        # actions. Bounded at 2: a worker has at most one request in flight
+        # plus one idempotent re-send — anything deeper is a protocol bug,
+        # and backpressure here must surface, not buffer
+        self.act_req = ctx.Queue(maxsize=2)
+        self.act_resp = ctx.Queue(maxsize=4)
         self.heartbeat = ctx.Value("q", 0, lock=False)
         self.param_version = ctx.Value("q", 0, lock=False)
         self.stop = ctx.Event()
 
     # -- worker side -------------------------------------------------------
+    def act_request(
+        self, req: Any, timeout_s: float = 30.0, beat: Optional[Any] = None
+    ) -> Any:
+        """Ship one act request and block for its response, pulsing ``beat``
+        every poll slice so the wait never reads as a worker hang. The
+        request is re-sent once a second while unanswered (the service
+        dedups by ``(worker_id, incarnation, req_id)`` — a re-send recovers
+        a response lost to a restarted learner-side pump, it never
+        double-steps latents). Raises ``TimeoutError`` past ``timeout_s``."""
+        import queue as _q
+
+        rid = int(req.get("req_id", 0))
+        deadline = time.monotonic() + float(timeout_s)
+        resend_at = 0.0
+        while True:
+            now = time.monotonic()
+            if now >= deadline:
+                raise TimeoutError(f"act request {rid} not answered within {timeout_s}s")
+            if self.stop.is_set():
+                raise ChannelStopped(f"act request {rid}: channel stopped")
+            if now >= resend_at:
+                resend_at = now + 1.0
+                try:
+                    self.act_req.put_nowait(req)
+                except _q.Full:
+                    pass  # previous send still queued: the service will get it
+            if beat is not None:
+                beat()
+            try:
+                resp = self.act_resp.get(timeout=min(0.1, max(0.0, deadline - now)))
+            except _q.Empty:
+                continue
+            if int(resp.get("req_id", -1)) == rid:
+                return resp
+            # a stale response (an abandoned earlier request): drop and wait
+
     def telem_put(self, batch: Any) -> bool:
         """Non-blocking relay of one telemetry batch; False == dropped."""
         try:
@@ -194,7 +245,7 @@ class WorkerChannel:
         return out
 
     def close(self) -> None:
-        for q in (self.data, self.ctrl, self.telem):
+        for q in (self.data, self.ctrl, self.telem, self.act_req, self.act_resp):
             try:
                 q.close()
                 # do NOT join_thread(): a feeder mid-pickle on a dead queue

@@ -51,6 +51,7 @@ from .protocol import (
     CTRL_PARAMS,
     CTRL_PROFILE,
     CTRL_STOP,
+    ChannelStopped,
     FleetPacket,
     WorkerChannel,
     encode_packet,
@@ -136,6 +137,13 @@ def fleet_worker_loop(
                 pass
 
     program.beat = _beat
+    # batched-inference acting (fleet.act_mode=inference): the program ships
+    # obs batches through the channel's act_request and tags requests with
+    # its identity so the learner-side service can key latents + dedup
+    # retries per (worker_id, incarnation)
+    program.trace_emit = _trace_emit
+    program.act_transport = channel
+    program.act_identity = (worker_id, incarnation)
     while not channel.stop.is_set():
         # ---- control: drain to the newest publication --------------------
         latest: Optional[tuple] = None
@@ -308,7 +316,10 @@ def worker_entry(spec: Dict[str, Any], channel: Optional[WorkerChannel], chaos: 
             chaos.incarnation = incarnation
         fleet_worker_loop(program, channel, chaos, worker_id, incarnation, sink, profiler)
         rc = 0
-    except KeyboardInterrupt:
+    except (KeyboardInterrupt, ChannelStopped):
+        # ChannelStopped: the learner stopped the channel (wall-cap/SIGTERM
+        # shutdown) while this worker was parked on an act request — a clean
+        # stop, not a death
         rc = 0
     except BaseException:
         print(

@@ -103,6 +103,7 @@ class ServeStats:
         self.batches = 0
         self.batched_items = 0
         self._occupancy_sum = 0.0
+        self._pad_waste_sum = 0.0
         self._batch_seconds_sum = 0.0
         self.registry = registry if registry is not None else Registry(prefix="sheeprl_serve")
         self._m_requests = self.registry.counter("requests_total", "act requests submitted")
@@ -114,6 +115,12 @@ class ServeStats:
         )
         self._m_occupancy = self.registry.histogram(
             "batch_occupancy", "batch fill fraction of its compiled bucket", FRACTION_BUCKETS
+        )
+        # the complement seen from the device's side: rows of each dispatched
+        # bucket that were zero-padding — the batching-efficiency knob
+        # (serve.buckets / max_wait_ms) made directly observable
+        self._m_pad_waste = self.registry.histogram(
+            "pad_waste", "padded row fraction of each dispatched bucket", FRACTION_BUCKETS
         )
         self._m_batch_size = self.registry.histogram(
             "batch_size", "coalesced batch width", (1, 2, 4, 8, 16, 32, 64, 128)
@@ -140,12 +147,15 @@ class ServeStats:
         self.registry.counter("session_expired_total", "requests answered 410 session_expired").inc()
 
     def record_batch(self, n: int, bucket: int, seconds: float) -> None:
+        waste = (max(0, bucket - n)) / max(1, bucket)
         with self._lock:
             self.batches += 1
             self.batched_items += n
             self._occupancy_sum += n / max(1, bucket)
+            self._pad_waste_sum += waste
             self._batch_seconds_sum += seconds
         self._m_occupancy.observe(n / max(1, bucket))
+        self._m_pad_waste.observe(waste)
         self._m_batch_size.observe(n)
 
     def record_done(self, latency_s: float, error: bool = False) -> None:
@@ -172,6 +182,9 @@ class ServeStats:
                 "expired": self.expired,
                 "batches": self.batches,
                 "batch_occupancy": round(self._occupancy_sum / self.batches, 4)
+                if self.batches
+                else 0.0,
+                "pad_waste": round(self._pad_waste_sum / self.batches, 4)
                 if self.batches
                 else 0.0,
                 "avg_batch_size": round(self.batched_items / self.batches, 4)

@@ -151,6 +151,10 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "reloads": (False, _NUM),
         "params_version": (False, _NUM),
         "sessions": (False, _NUM),
+        # padded-row fraction of dispatched buckets (mean over batches):
+        # (bucket - rows)/bucket — the batching-efficiency complement of
+        # batch_occupancy, also a Prometheus histogram
+        "pad_waste": (False, _NUM),
     },
     # checkpoint hot-reload attempts (serve/reload.py)
     "reload": {
@@ -267,6 +271,18 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         # learner-side relay drops (telemetry batches the learner's bounded
         # buffer shed; worker-side drops ride each worker's `relay` events)
         "relay_dropped": (False, _NUM),
+        # batched-inference act service (fleet/act_service.py), present on
+        # interval snapshots when fleet.act_mode=inference: request/batch
+        # totals, mean bucket occupancy and pad-waste fraction, live
+        # recurrent-state session rows, and the acting publication version
+        # (the act_service_starvation finding reads occupancy)
+        "act_mode": (False, _STR),
+        "act_requests": (False, _NUM),
+        "act_batches": (False, _NUM),
+        "act_occupancy": (False, _NUM),
+        "act_pad_waste": (False, _NUM),
+        "act_sessions": (False, _NUM),
+        "act_version": (False, _NUM),
     },
     # socket-transport link lifecycle (sheeprl_tpu/fleet/net.py): learner
     # events (listen | accept | reconnect | refuse | disconnect | resync |
