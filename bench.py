@@ -151,7 +151,26 @@ def _timed_cli_run(
         rec["peak_flops_basis"] = peak_flops_basis_for(jax.devices()[0])
     except Exception:
         pass
+    _stamp_memory_peaks(rec)
     return rec
+
+
+def _stamp_memory_peaks(rec: dict) -> None:
+    """Peak host RSS (kernel VmHWM) + device allocator high-water onto a
+    bench record — informational, like binding_stage: bench_compare shows
+    the drift but never gates on it."""
+    try:
+        from sheeprl_tpu.telemetry.memory import host_rss_peak_bytes
+        from sheeprl_tpu.telemetry.xla import device_memory_stats
+
+        peak = host_rss_peak_bytes()
+        if peak:
+            rec["peak_rss_bytes"] = int(peak)
+        dev = device_memory_stats()
+        if dev.get("peak_bytes_in_use"):
+            rec["device_peak_bytes"] = int(dev["peak_bytes_in_use"])
+    except Exception:
+        pass
 
 
 def bench_recipe(which: str) -> dict:

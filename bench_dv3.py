@@ -226,6 +226,20 @@ def record() -> dict:
             rec["mfu"] = round(_mfu(flops_per_step, sps, peak, n_dev), 4)
             rec["peak_flops_assumed"] = peak
             rec["devices"] = n_dev
+    # memory high-waters of the bench process (informational, never gated):
+    # kernel VmHWM for the host, allocator peak_bytes_in_use for the device
+    try:
+        from sheeprl_tpu.telemetry.memory import host_rss_peak_bytes
+        from sheeprl_tpu.telemetry.xla import device_memory_stats
+
+        peak_rss = host_rss_peak_bytes()
+        if peak_rss:
+            rec["peak_rss_bytes"] = int(peak_rss)
+        dev_stats = device_memory_stats()
+        if dev_stats.get("peak_bytes_in_use"):
+            rec["device_peak_bytes"] = int(dev_stats["peak_bytes_in_use"])
+    except Exception:
+        pass
     return rec
 
 

@@ -367,6 +367,8 @@ def compare(
             "preflight_attempts": newest.get("preflight_attempts"),
             # informational only — attribution context, never a gate
             "binding_stage": newest.get("binding_stage"),
+            "peak_rss_bytes": newest.get("peak_rss_bytes"),
+            "device_peak_bytes": newest.get("device_peak_bytes"),
         }
         if not priors:
             report["note"] = (
@@ -396,6 +398,7 @@ def compare(
                 "unit": newest_s.get("unit"),
                 "platform_class": platform_class(newest_s),
                 "binding_stage": newest_s.get("binding_stage"),
+                "peak_rss_bytes": newest_s.get("peak_rss_bytes"),
             }
             _gate_fields(
                 report,

@@ -405,6 +405,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             record["binding_stage"] = stage
     except Exception:
         pass
+    try:
+        # driver-process memory high-water, informational like binding_stage
+        from sheeprl_tpu.telemetry.memory import host_rss_peak_bytes
+
+        peak_rss = host_rss_peak_bytes()
+        if peak_rss:
+            record["peak_rss_bytes"] = int(peak_rss)
+    except Exception:
+        pass
     problems = validate_event(record)
     if problems:
         print(f"[bench_flywheel] SCHEMA-INVALID record: {problems}", file=sys.stderr)

@@ -685,6 +685,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                 record["binding_stage"] = stage
         except Exception:
             pass
+    # memory high-waters of the driver process (the gateway runs in-process
+    # here; replicas report their own via mem events) — informational
+    try:
+        from sheeprl_tpu.telemetry.memory import host_rss_peak_bytes
+        from sheeprl_tpu.telemetry.xla import device_memory_stats
+
+        peak_rss = host_rss_peak_bytes()
+        if peak_rss:
+            record["peak_rss_bytes"] = int(peak_rss)
+        dev_stats = device_memory_stats()
+        if dev_stats.get("peak_bytes_in_use"):
+            record["device_peak_bytes"] = int(dev_stats["peak_bytes_in_use"])
+    except Exception:
+        pass
     problems = validate_event(record)
     if problems:
         print(f"[bench_serve] SCHEMA-INVALID record: {problems}", file=sys.stderr)

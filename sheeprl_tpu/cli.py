@@ -413,6 +413,23 @@ def top(args: Optional[Sequence[str]] = None) -> None:
         raise SystemExit(rc)
 
 
+def prof(args: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl_tpu prof run_dir=<logs/runs/.../version_N> [capture=<dir>]
+    [top_k=15] [json=true]` — where the chip time goes (prof/cli.py):
+    ingests every on-demand `jax.profiler` capture of the run (or one
+    explicit capture dir), prints the top-K device ops with their per-scope
+    attribution (TraceAnnotation scopes like `train`), the device-idle
+    fraction per capture window, and the run's roofline verdicts per
+    tracked jitted fn (compute- vs memory-bound, attained fraction of the
+    roof)."""
+    argv = list(args if args is not None else sys.argv[1:])
+    from .prof.cli import main as prof_main
+
+    rc = prof_main(argv)
+    if rc:
+        raise SystemExit(rc)
+
+
 def lint(args: Optional[Sequence[str]] = None) -> None:
     """`sheeprl_tpu lint [paths...] [--json] [--rule r1,r2] [--list-rules]` —
     the JAX-aware static-analysis pass (analysis/): host-sync, retrace-hazard,
@@ -491,11 +508,11 @@ def available_agents() -> None:
 
 
 def main() -> None:
-    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|gateway|brokerd|flywheel|doctor|trace|top|lint|registration|agents> ...`"""
+    """Console dispatcher: `python -m sheeprl_tpu <run|eval|resume|serve|gateway|brokerd|flywheel|doctor|trace|top|prof|lint|registration|agents> ...`"""
     argv = sys.argv[1:]
     if argv and argv[0] in (
         "run", "eval", "evaluation", "resume", "serve", "gateway", "brokerd", "flywheel",
-        "doctor", "trace", "top", "lint", "registration", "agents",
+        "doctor", "trace", "top", "prof", "lint", "registration", "agents",
     ):
         cmd, rest = argv[0], argv[1:]
     else:
@@ -520,6 +537,8 @@ def main() -> None:
         trace(rest)
     elif cmd == "top":
         top(rest)
+    elif cmd == "prof":
+        prof(rest)
     elif cmd == "lint":
         lint(rest)
     elif cmd == "registration":

@@ -326,10 +326,36 @@ class LiveAggregator:
         latest: Dict[str, Dict[str, Any]] = {}
         stage_durs: Dict[Tuple[str, str], List[float]] = {}
         lags: List[float] = []
+        mem_rows: Dict[str, Dict[str, Any]] = {}
+        mem_high: Dict[str, Dict[str, int]] = {}
         sps = mfu = retraces = None
         for rec in events:
             streams[rec.get("_stream", "main")] = streams.get(rec.get("_stream", "main"), 0) + 1
             event = rec.get("event")
+            if event == "mem":
+                # latest sample per emitting process + per-role high-waters
+                role = str(rec.get("role") or "?")
+                index = rec.get("index", rec.get("worker", rec.get("replica")))
+                key = f"{role}_{int(index):03d}" if index is not None else role
+                row: Dict[str, Any] = {"role": role}
+                for f in (
+                    "rss_bytes", "rss_peak_bytes", "hbm_bytes_in_use",
+                    "hbm_peak_bytes", "hbm_bytes_limit", "live_buffers",
+                    "live_buffer_bytes", "step", "t",
+                ):
+                    if rec.get(f) is not None:
+                        row[f] = rec[f]
+                mem_rows[key] = row
+                high = mem_high.setdefault(role, {"rss_bytes": 0, "hbm_bytes": 0})
+                high["rss_bytes"] = max(
+                    high["rss_bytes"],
+                    int(rec.get("rss_peak_bytes") or rec.get("rss_bytes") or 0),
+                )
+                high["hbm_bytes"] = max(
+                    high["hbm_bytes"],
+                    int(rec.get("hbm_peak_bytes") or rec.get("hbm_bytes_in_use") or 0),
+                )
+                continue
             if event == "log":
                 if rec.get("sps") is not None:
                     sps = float(rec["sps"])
@@ -384,6 +410,12 @@ class LiveAggregator:
             if lags
             else None,
             "binding_stage": binding_stage_for_events(events, self._cfg),
+            "memory": {
+                "streams": {k: mem_rows[k] for k in sorted(mem_rows)},
+                "high_water": {r: dict(mem_high[r]) for r in sorted(mem_high)},
+            }
+            if mem_rows
+            else None,
             "relay": relay,
             "ingested": self.ingested,
             "relayed": self.relayed,

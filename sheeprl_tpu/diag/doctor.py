@@ -169,6 +169,7 @@ def diagnose(
         "last_step": tl.last_step,
         "clean_shutdown": tl.shutdown is not None,
         "throughput": _throughput_summary(tl),
+        "compile": tl.compile_summary(),
         "manifest": read_manifest(log_dir),
         "checkpoints": _ckpt_summary(log_dir),
         "findings": [f.to_dict() for f in findings],
@@ -242,6 +243,24 @@ def render_text(report: Dict[str, Any]) -> str:
         + (f", newest @ step {ckpt['newest_step']}" if "newest_step" in ckpt else "")
         + (f"; manifest @ step {manifest['step']}" if manifest.get("step") is not None else "; no manifest")
     )
+    compile_sum = report.get("compile") or {}
+    if compile_sum.get("compiles") is not None:
+        part = f"  compiles: {compile_sum['compiles']}"
+        if compile_sum.get("compile_seconds") is not None:
+            part += f" ({compile_sum['compile_seconds']:.1f}s)"
+        if compile_sum.get("cache_hits") is not None or compile_sum.get("cache_misses") is not None:
+            part += (
+                f"; persistent cache {int(compile_sum.get('cache_hits') or 0)} hit(s) / "
+                f"{int(compile_sum.get('cache_misses') or 0)} miss(es)"
+            )
+        worst = list((compile_sum.get("breakdown") or {}).items())[:3]
+        if worst:
+            part += "; worst: " + ", ".join(
+                f"{tag} {float((row or {}).get('seconds') or 0.0):.1f}s"
+                f"×{int((row or {}).get('count') or 0)}"
+                for tag, row in worst
+            )
+        lines.append(part)
     if len(report.get("stream_segments", [])) > 1:
         lines.append(f"  stream: {len(report['stream_segments'])} rotated segment(s) read in order")
     if report.get("process_streams"):

@@ -1033,6 +1033,159 @@ def detect_slo_alerts(tl: Timeline, cfg: Any = None) -> List[Finding]:
     ]
 
 
+def detect_hbm_pressure(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Device high-water within a whisker of the allocator limit: the run
+    survived, but any growth (longer sequence bucket, one more replica,
+    larger batch) tips it into OOM. The cadenced ``mem`` samples carry the
+    allocator's own ``peak_bytes_in_use``/``bytes_limit``, so the check is a
+    single ratio."""
+    frac = float(_sel(cfg, "diag.mem.hbm_frac", 0.92))
+    peak, limit = tl.hbm_high_water()
+    if not limit or peak < frac * limit:
+        return []
+    used_pct = 100.0 * peak / limit
+    return [
+        Finding(
+            code="hbm_pressure",
+            severity="warning",
+            title=f"HBM high-water at {used_pct:.1f}% of the allocator limit",
+            detail=(
+                f"Device memory peaked at {peak / 2**30:.2f} GiB of the "
+                f"{limit / 2**30:.2f} GiB limit (threshold {frac:.0%}). The next "
+                "shape bucket, batch bump or extra live buffer OOMs."
+            ),
+            remediation=(
+                "Free headroom before it becomes an OOM: enable donation on the "
+                "update's carried state (donate_argnums), shrink the replay "
+                "slice per fetch, or shard the params/optimizer over the fsdp "
+                "mesh axis. `sheeprl_tpu prof run_dir=...` shows which ops "
+                "dominate; the live-buffer census in the mem events shows what "
+                "is pinned between steps."
+            ),
+            step_first=0,
+            step_last=tl.last_step,
+            data={"hbm_peak_bytes": peak, "hbm_bytes_limit": limit, "frac": round(peak / limit, 4)},
+        )
+    ]
+
+
+def detect_host_mem_leak(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Sustained monotonic host-RSS growth: a python-side leak (unbounded
+    replay list, cached compiles, spans never flushed) that kills week-long
+    runs with a host OOM long after every device metric looks healthy.
+    Fires when a role's RSS series spans long enough, grows past the floor,
+    and rises in (nearly) every interval — a sawtooth from GC churn stays
+    quiet."""
+    window_s = float(_sel(cfg, "diag.mem.leak_window_s", 120.0))
+    min_growth = float(_sel(cfg, "diag.mem.leak_min_growth_mb", 64.0)) * 2**20
+    min_samples = int(_sel(cfg, "diag.mem.leak_min_samples", 6))
+    rise_frac = float(_sel(cfg, "diag.mem.leak_rise_frac", 0.8))
+    out: List[Finding] = []
+    for role in tl.mem_roles() or ([None] if tl.of("mem") else []):
+        series = tl.rss_series(role)
+        if len(series) < min_samples:
+            continue
+        span_s = series[-1][0] - series[0][0]
+        growth = series[-1][1] - series[0][1]
+        if span_s < window_s or growth < min_growth:
+            continue
+        deltas = [b2 - b1 for (_, b1), (_, b2) in zip(series, series[1:])]
+        rising = sum(1 for d in deltas if d > 0) / max(1, len(deltas))
+        if rising < rise_frac:
+            continue
+        rate_mb_h = growth / 2**20 / (span_s / 3600.0)
+        out.append(
+            Finding(
+                code="host_mem_leak",
+                severity="warning",
+                title=(
+                    f"host RSS grows monotonically in role '{role or 'main'}': "
+                    f"+{growth / 2**20:.0f} MiB over {span_s / 60:.0f} min"
+                ),
+                detail=(
+                    f"{series[0][1] / 2**20:.0f} → {series[-1][1] / 2**20:.0f} MiB "
+                    f"({rate_mb_h:.0f} MiB/h, rising in {rising:.0%} of "
+                    f"{len(deltas)} sample intervals). At this rate the host "
+                    "OOM-killer ends the run, not the training loop."
+                ),
+                remediation=(
+                    "Look for unbounded python-side accumulation: replay/rollout "
+                    "lists that only append, per-step metric dicts retained by a "
+                    "logger, jax compilation caches growing under retraces (check "
+                    "the retrace counters), or numpy copies of device arrays kept "
+                    "alive. The live-buffer census in the mem events separates "
+                    "device-array leaks from pure-python ones."
+                ),
+                step_first=0,
+                step_last=tl.last_step,
+                data={
+                    "role": role or "main",
+                    "growth_bytes": int(growth),
+                    "span_s": round(span_s, 1),
+                    "rate_mb_per_h": round(rate_mb_h, 1),
+                    "samples": len(series),
+                },
+            )
+        )
+    return out
+
+
+def detect_memory_bound(tl: Timeline, cfg: Any = None) -> List[Finding]:
+    """Roofline verdict: a tracked jitted fn whose arithmetic intensity sits
+    below the device's ridge point is bandwidth-bound — more FLOPS (bigger
+    chip, more chips) will not speed it up; only fusing ops, reusing
+    activations or casting dtypes down will. Informational: being memory-
+    bound is a property of the program, not automatically a defect."""
+    if not bool(_sel(cfg, "diag.roofline.enabled", True)):
+        return []
+    bound_fns = {
+        name: rec
+        for name, rec in tl.rooflines().items()
+        if rec.get("bound") == "memory"
+    }
+    if not bound_fns:
+        return []
+    parts = []
+    for name, rec in sorted(bound_fns.items()):
+        note = f"{name}: {float(rec.get('intensity') or 0):.1f} flop/B"
+        if rec.get("ridge_intensity") is not None:
+            note += f" vs ridge {float(rec['ridge_intensity']):.0f}"
+        if rec.get("attained_frac") is not None:
+            note += f", attaining {float(rec['attained_frac']):.0%} of roof"
+        parts.append(note)
+    steps = [int(rec.get("step") or 0) for rec in bound_fns.values()]
+    return [
+        Finding(
+            code="memory_bound",
+            severity="info",
+            title=(
+                f"{len(bound_fns)} jitted fn(s) are memory-bandwidth-bound: "
+                + ", ".join(sorted(bound_fns))
+            ),
+            detail="; ".join(parts),
+            remediation=(
+                "Raise arithmetic intensity rather than chasing FLOPS: fuse "
+                "elementwise chains into the consuming matmul (jit already "
+                "does most of this — check `sheeprl_tpu prof` for fusion "
+                "boundaries), keep activations in bf16, and batch small "
+                "per-step ops together. If the fn is inherently bandwidth-"
+                "bound (optimizers, scatters), its attained fraction of the "
+                "bandwidth roof is the number to optimize."
+            ),
+            step_first=min(steps) if steps else 0,
+            step_last=max(steps) if steps else tl.last_step,
+            data={
+                name: {
+                    k: rec.get(k)
+                    for k in ("intensity", "ridge_intensity", "attained_frac", "bound", "device_kind")
+                    if rec.get(k) is not None
+                }
+                for name, rec in bound_fns.items()
+            },
+        )
+    ]
+
+
 DETECTORS: List[Callable[[Timeline, Any], List[Finding]]] = [
     detect_retrace_storm,
     detect_overlap_starvation,
@@ -1054,6 +1207,9 @@ DETECTORS: List[Callable[[Timeline, Any], List[Finding]]] = [
     detect_replicated_giant,
     detect_slo_alerts,
     detect_incomplete_stream,
+    detect_hbm_pressure,
+    detect_host_mem_leak,
+    detect_memory_bound,
 ]
 
 

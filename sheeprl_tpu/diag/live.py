@@ -150,6 +150,23 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
             f"\npublish→apply lag: p50 {lag.get('p50')}ms  p95 {lag.get('p95')}ms "
             f"({lag.get('count')} applies)"
         )
+    memory = snap.get("memory") or {}
+    if memory.get("streams"):
+        lines.append("\n  {:<20} {:>10} {:>10} {:>10} {:>10}".format(
+            "process", "rss MiB", "rss peak", "hbm MiB", "hbm peak"))
+        for name, row in sorted(memory["streams"].items()):
+            def _mib(key: str) -> str:
+                val = row.get(key)
+                return f"{int(val) >> 20}" if val else "-"
+            lines.append("  {:<20} {:>10} {:>10} {:>10} {:>10}".format(
+                name[:20], _mib("rss_bytes"), _mib("rss_peak_bytes"),
+                _mib("hbm_bytes_in_use"), _mib("hbm_peak_bytes")))
+        high = memory.get("high_water") or {}
+        if high:
+            lines.append("  high-water: " + "  ".join(
+                f"{role} rss={int(hw.get('rss_bytes') or 0) >> 20}MiB"
+                + (f" hbm={int(hw['hbm_bytes']) >> 20}MiB" if hw.get("hbm_bytes") else "")
+                for role, hw in sorted(high.items())))
     for role in ("fleet", "gateway", "broker", "overlap"):
         row = snap.get(role)
         if row:

@@ -331,6 +331,16 @@ class Registry:
             # export as a gauge so the scrape matches the JSONL semantics
             if xla.get("retraces") is not None:
                 self.gauge("xla_retraces", "retraces since run start").set(float(xla["retraces"]))
+            # persistent-compilation-cache accounting: run-cumulative deltas
+            # in the JSONL, mirrored as monotonic *_total counters by
+            # incrementing with the per-interval difference
+            for key, metric in (("cache_hits", "compile_cache_hits_total"),
+                                ("cache_misses", "compile_cache_misses_total")):
+                if xla.get(key) is not None:
+                    ctr = self.counter(metric, "persistent compilation cache " + key.replace("_", " "))
+                    delta = float(xla[key]) - ctr.value
+                    if delta > 0:
+                        ctr.inc(delta)
         elif event == "overlap":
             self.gauge("overlap_queue_depth", "player→learner queue occupancy").set(
                 float(rec.get("queue_depth") or 0)
@@ -479,6 +489,43 @@ class Registry:
                     "stage": str(rec.get("name") or "unknown"),
                 },
             ).observe(float(rec.get("dur_ms") or 0.0))
+        elif event == "mem":
+            # cadenced memory samples (telemetry/memory.py): per-role
+            # gauges — the role vocabulary is the closed process-role set
+            # (learner | worker | replica | broker), so the family stays
+            # bounded even with every stream relayed in
+            role = str(rec.get("role") or "unknown")
+            self.gauge(
+                "host_rss_bytes", "host resident set size by role", labels={"role": role}
+            ).set(float(rec.get("rss_bytes") or 0))
+            if rec.get("hbm_bytes_in_use") is not None:
+                self.gauge(
+                    "hbm_bytes_in_use", "device HBM bytes in use by role", labels={"role": role}
+                ).set(float(rec["hbm_bytes_in_use"]))
+            if rec.get("hbm_peak_bytes") is not None:
+                self.gauge(
+                    "hbm_peak_bytes", "device HBM high-water by role", labels={"role": role}
+                ).set(float(rec["hbm_peak_bytes"]))
+            if rec.get("live_buffer_bytes") is not None:
+                self.gauge(
+                    "live_buffer_bytes", "live device-array bytes by role", labels={"role": role}
+                ).set(float(rec["live_buffer_bytes"]))
+        elif event == "roofline":
+            # roofline verdicts: attained fraction of the binding roof per
+            # jitted fn. `fn` is low-cardinality by construction (train
+            # step + one name per serve bucket)
+            if rec.get("attained_frac") is not None:
+                self.gauge(
+                    "roofline_attained_frac",
+                    "attained fraction of the binding roofline per jitted fn",
+                    labels={"fn": str(rec.get("fn") or "unknown")},
+                ).set(float(rec["attained_frac"]))
+            if rec.get("intensity") is not None:
+                self.gauge(
+                    "roofline_intensity",
+                    "arithmetic intensity (flops/byte) per jitted fn",
+                    labels={"fn": str(rec.get("fn") or "unknown")},
+                ).set(float(rec["intensity"]))
         elif event == "shutdown":
             self.gauge("up", "1 while the run is alive").set(0.0)
         elif event == "rotate":

@@ -112,7 +112,16 @@ def iter_events(path: Any, errors: Optional[List[str]] = None) -> Iterator[Dict[
 # dropped at ingestion so a week-long rotated stream never has to fit in
 # memory as full python dicts
 _LOG_KEEP = ("event", "step", "t", "sps", "interval_steps", "interval_seconds")
-_LOG_XLA_KEEP = ("retraces", "retrace_attribution", "compile_count", "compiles_in_interval")
+_LOG_XLA_KEEP = (
+    "retraces",
+    "retrace_attribution",
+    "compile_count",
+    "compile_seconds",
+    "compiles_in_interval",
+    "cache_hits",
+    "cache_misses",
+    "compile_breakdown",
+)
 
 
 def _slim_log(rec: Dict[str, Any]) -> Dict[str, Any]:
@@ -236,6 +245,71 @@ class Timeline:
         out: List[str] = []
         for _, _, attr in self.retrace_intervals():
             out.extend(attr)
+        return out
+
+    def rss_series(self, role: Optional[str] = None) -> List[Tuple[float, int]]:
+        """(t, rss_bytes) from the cadenced ``mem`` stream, ordered by time.
+        ``role=None`` keeps every sampler's points (single-process runs have
+        exactly one role anyway); the leak detector filters per role so one
+        process's growth is never masked by another's churn."""
+        out = []
+        for rec in self.of("mem"):
+            if role is not None and rec.get("role") != role:
+                continue
+            if rec.get("t") is not None and rec.get("rss_bytes") is not None:
+                out.append((float(rec["t"]), int(rec["rss_bytes"])))
+        out.sort(key=lambda p: p[0])
+        return out
+
+    def mem_roles(self) -> List[str]:
+        return sorted({str(rec.get("role") or "") for rec in self.of("mem")} - {""})
+
+    def hbm_high_water(self) -> Tuple[int, int]:
+        """(max device high-water bytes, bytes_limit) over every ``mem``
+        sample — (0, 0) on CPU-only streams where the device fields are
+        absent."""
+        peak = limit = 0
+        for rec in self.of("mem"):
+            peak = max(peak, int(rec.get("hbm_peak_bytes") or rec.get("hbm_bytes_in_use") or 0))
+            limit = max(limit, int(rec.get("hbm_bytes_limit") or 0))
+        return peak, limit
+
+    def compile_summary(self) -> Dict[str, Any]:
+        """Run-total compile accounting from the LAST log interval (the
+        xla fields are run-cumulative deltas): compile count/seconds,
+        persistent-cache hits/misses, and the per-function breakdown with
+        the worst offenders first."""
+        last: Dict[str, Any] = {}
+        for rec in self.of("log"):
+            if isinstance(rec.get("xla"), dict):
+                last = rec["xla"]
+        out: Dict[str, Any] = {}
+        for src, dst in (
+            ("compile_count", "compiles"),
+            ("compile_seconds", "compile_seconds"),
+            ("cache_hits", "cache_hits"),
+            ("cache_misses", "cache_misses"),
+        ):
+            if last.get(src) is not None:
+                out[dst] = last[src]
+        breakdown = last.get("compile_breakdown")
+        if isinstance(breakdown, dict) and breakdown:
+            out["breakdown"] = dict(
+                sorted(
+                    breakdown.items(),
+                    key=lambda kv: -float((kv[1] or {}).get("seconds") or 0.0),
+                )
+            )
+        return out
+
+    def rooflines(self) -> Dict[str, Dict[str, Any]]:
+        """Latest ``roofline`` verdict per jitted-fn name (later emits carry
+        the measured call rate, so last-wins is the most informed one)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for rec in self.of("roofline"):
+            name = rec.get("fn")
+            if name:
+                out[str(name)] = rec
         return out
 
     def overlap_stalls(self) -> List[Tuple[int, float]]:

@@ -246,6 +246,29 @@ def _critical_path(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return path
 
 
+def _profile_verdict(trace_dir: str) -> Dict[str, Any]:
+    """One capture's ingested verdict: device busy/idle plus the dominant
+    op, via the sheeprl_tpu.prof parser. A capture that moved hosts, is
+    still being written, or predates the trace-event format degrades to the
+    bare path — the trace report must render regardless."""
+    row: Dict[str, Any] = {"dir": trace_dir}
+    try:
+        from ..prof import summarize_capture
+
+        summary = summarize_capture(trace_dir, top_k=1)
+        row["device_busy_us"] = summary["device_busy_us"]
+        row["device_idle_frac"] = summary["device_idle_frac"]
+        if summary["ops"]:
+            top = summary["ops"][0]
+            row["top_op"] = top["op"]
+            row["top_op_frac"] = top["frac"]
+            if top.get("scope"):
+                row["top_scope"] = top["scope"]
+    except Exception as exc:
+        row["error"] = str(exc)
+    return row
+
+
 def analyze(
     log_dir: Any,
     trace_id: Optional[str] = None,
@@ -325,13 +348,17 @@ def analyze(
     )
 
     # -- on-demand profiler captures ----------------------------------------
-    profiles = sorted(
+    # each capture gets an ingested one-line verdict (device busy/idle and
+    # the dominant op via sheeprl_tpu.prof), not just a path the reader has
+    # to open in XProf to learn anything from
+    profile_dirs = sorted(
         {
             str(rec.get("trace_dir"))
             for rec in events
             if rec.get("event") == "trace" and rec.get("action") == "started" and rec.get("trace_dir")
         }
     )
+    profiles = [_profile_verdict(p) for p in profile_dirs]
 
     path_traces = [v for v in views if v["kind"] in ("round", "request")]
     slowest = sorted(path_traces, key=lambda v: -v["duration_ms"])[: max(0, int(top_k))]
@@ -429,9 +456,24 @@ def render_text(report: Dict[str, Any]) -> str:
                 + _fmt_path(v["path"])
             )
     if report.get("profiles"):
-        lines.append("\n  profiler captures (open in XProf/TensorBoard):")
+        lines.append("\n  profiler captures (`sheeprl_tpu prof capture=<dir>` for the full table):")
         for p in report["profiles"]:
-            lines.append(f"    {p}")
+            if isinstance(p, str):  # pre-ingestion report loaded from JSON
+                lines.append(f"    {p}")
+                continue
+            lines.append(f"    {p['dir']}")
+            if p.get("error"):
+                lines.append(f"      (not ingestable here: {p['error']})")
+                continue
+            verdict = f"      device busy {p.get('device_busy_us', 0) / 1e3:.1f}ms"
+            if p.get("device_idle_frac") is not None:
+                verdict += f", idle {100.0 * p['device_idle_frac']:.1f}%"
+            if p.get("top_op"):
+                verdict += f"; top op {p['top_op']} ({100.0 * (p.get('top_op_frac') or 0):.0f}%"
+                if p.get("top_scope"):
+                    verdict += f", scope {p['top_scope']}"
+                verdict += ")"
+            lines.append(verdict)
     trace = report.get("trace")
     if trace is not None:
         lines.append(f"\n  trace {trace['trace_id']} [{trace['kind']}] {trace['duration_ms']:.1f}ms:")

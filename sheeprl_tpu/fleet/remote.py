@@ -92,6 +92,11 @@ def main(argv: Optional[list] = None) -> int:
         return 3
     attach_worker_relay(sink, channel, spec.get("relay") or {}, int(args.worker_id))
     cfg = Config(spec["cfg"])
+    # mem events through the tee: the remote host's RSS reaches the
+    # learner-side aggregator even when this worker has no local log dir
+    from ..telemetry.memory import start_sampler
+
+    mem_sampler = start_sampler(cfg, sink.write, "worker", int(args.worker_id))
     program = _resolve_program(str(spec["program"]))(
         cfg, int(args.worker_id), int(spec["num_workers"])
     )
@@ -102,6 +107,11 @@ def main(argv: Optional[list] = None) -> int:
             program, channel, None, int(args.worker_id), channel.incarnation, sink
         )
     finally:
+        if mem_sampler is not None:
+            try:
+                mem_sampler.stop()
+            except Exception:
+                pass
         try:
             sink.close()  # final relay flush rides the still-open channel
         except Exception:

@@ -263,6 +263,7 @@ def worker_entry(spec: Dict[str, Any], channel: Optional[WorkerChannel], chaos: 
     incarnation = int(spec["incarnation"])
     sink = None
     profiler = None
+    mem_sampler = None
     try:
         # tame the child's footprint before jax initializes: workers are
         # numpy/env-bound, a thread pool per worker just thrashes the host
@@ -304,6 +305,12 @@ def worker_entry(spec: Dict[str, Any], channel: Optional[WorkerChannel], chaos: 
             )
         attach_worker_relay(sink, channel, relay_cfg, worker_id)
         cfg = Config(spec["cfg"])
+        if sink is not None:
+            # cadenced mem events on the worker's own stream (and through
+            # the relay tee, so the learner's aggregator sees fleet RSS)
+            from ..telemetry.memory import start_sampler
+
+            mem_sampler = start_sampler(cfg, sink.write, "worker", worker_id)
         program = _resolve_program(str(spec["program"]))(
             cfg, worker_id, int(spec["num_workers"])
         )
@@ -330,6 +337,11 @@ def worker_entry(spec: Dict[str, Any], channel: Optional[WorkerChannel], chaos: 
         )
         rc = 1
     finally:
+        if mem_sampler is not None:
+            try:
+                mem_sampler.stop()
+            except Exception:
+                pass
         if profiler is not None:
             try:
                 profiler.stop()

@@ -1045,10 +1045,23 @@ def brokerd_entry(spec: Dict[str, Any], port_q: Any) -> None:
         print(f"[brokerd] failed to start: {e!r}", file=sys.stderr, flush=True)
         raise
     port_q.put((str(spec.get("role", "primary")), server.port))
+    mem_sampler = None
+    if server.emit is not None:
+        # broker RSS timeline on its own stream (and relayed, when in-band
+        # relay is configured) — the broker is the process whose host-side
+        # growth (WAL buffers, session maps) no device metric would show
+        from ..telemetry.memory import start_sampler
+
+        mem_sampler = start_sampler(None, server.emit, "broker", int(spec.get("broker_id", 0)))
     try:
         while not stop.wait(0.2):
             pass
     finally:
+        if mem_sampler is not None:
+            try:
+                mem_sampler.stop()
+            except Exception:
+                pass
         server.close()
 
 

@@ -246,10 +246,24 @@ def replica_entry(spec: Dict[str, Any], port_q: Any) -> None:
         )
         raise
     port_q.put((int(spec.get("replica_id", 0)), int(spec.get("incarnation", 0)), server.port))
+    mem_sampler = None
+    if server.sink is not None:
+        # the replica's HBM/RSS timeline on its own stream (and through the
+        # relay tee to the gateway's aggregator)
+        from ..config import Config
+        from ..telemetry.memory import start_sampler
+
+        cfg = Config(spec["cfg"]) if spec.get("cfg") else None
+        mem_sampler = start_sampler(cfg, server.sink.write, "replica", int(spec.get("replica_id", 0)))
     try:
         while not stop.wait(0.2):
             pass
     finally:
+        if mem_sampler is not None:
+            try:
+                mem_sampler.stop()
+            except Exception:
+                pass
         server.stop()
 
 

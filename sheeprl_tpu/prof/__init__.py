@@ -1,0 +1,25 @@
+"""Device-level profile consumption (`sheeprl_tpu prof`).
+
+The emission side of profiling has existed for a while — RemoteProfiler
+windows, watchdog incident captures, the windowed cadence captures the
+facade drives — but every capture dir was announced on the telemetry
+stream and then left for a human with XProf. This package is the
+consumption side: parse the trace-event JSON each capture contains,
+aggregate device-lane activity into per-op / per-HLO-module device time,
+join it to the `TraceAnnotation` scope names the train loops stamp, and
+report top ops, per-scope device share and device-idle fraction per
+capture window — next to the run's roofline verdicts.
+"""
+from .capture import (
+    CaptureError,
+    find_trace_files,
+    parse_trace_file,
+    summarize_capture,
+)
+
+__all__ = [
+    "CaptureError",
+    "find_trace_files",
+    "parse_trace_file",
+    "summarize_capture",
+]

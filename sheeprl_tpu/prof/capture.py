@@ -1,0 +1,281 @@
+"""Trace-event capture parsing: `*.trace.json.gz` → per-op device time.
+
+Every `jax.profiler` capture dir holds, per host, a Chrome-trace-format
+JSON (`plugins/profile/<stamp>/<host>.trace.json.gz`) whose complete
+(`ph == "X"`) events fall into three populations:
+
+* **HLO op events** — lanes (pid, tid) carrying events with
+  ``args.hlo_op`` / ``args.hlo_module``: the device-side execution
+  timeline. A lane with at least one such event is a *device lane*; the
+  union of its op intervals is device-busy time.
+* **scope events** — the ``TraceAnnotation`` / ``StepTraceAnnotation``
+  names the train loops stamp (the facade's ``train`` step annotation,
+  ``telem.span`` names). They appear as plain named events on the host
+  lanes; attribution joins each op to the innermost scope whose interval
+  contains the op's midpoint.
+* **runtime noise** — python frames (names starting ``$``), C++ internals
+  (``::``), dispatch shims (``PjitFunction(...)``, ``ParseArguments``).
+  Filtered out of the scope population, never counted as device time.
+
+Timestamps/durations are microseconds (the Chrome trace convention jax
+emits). Uncompressed ``*.trace.json`` files are accepted too — synthetic
+fixtures and hand-extracted captures parse the same way.
+"""
+from __future__ import annotations
+
+import gzip
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "CaptureError",
+    "find_trace_files",
+    "parse_trace_file",
+    "summarize_capture",
+]
+
+# host-lane event names that are runtime machinery, not user scopes
+_NOISE_PREFIXES = (
+    "$",  # python frames ($api.py:2733 block_until_ready)
+    "PjitFunction(",
+    "ParseArguments",
+    "ThreadpoolListener",
+    "ThunkExecutor",
+    "TfrtCpuExecutable",
+    "PyGlobalCache",
+    "XlaComputation",
+)
+
+
+class CaptureError(RuntimeError):
+    """A capture dir or trace file that cannot be parsed."""
+
+
+def find_trace_files(capture_dir: Any) -> List[Path]:
+    """Every trace-event JSON under a capture dir (one per host per
+    window), compressed or not, in deterministic order."""
+    base = Path(capture_dir)
+    if base.is_file():
+        return [base]
+    if not base.is_dir():
+        return []
+    files = sorted(base.rglob("*.trace.json.gz")) + sorted(base.rglob("*.trace.json"))
+    return files
+
+
+def _load_trace_json(path: Path) -> Dict[str, Any]:
+    try:
+        if str(path).endswith(".gz"):
+            with gzip.open(path, "rt") as fh:
+                return json.load(fh)
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError, EOFError) as err:
+        raise CaptureError(f"unreadable trace file {path}: {err}") from err
+
+
+def _is_scope_name(name: str) -> bool:
+    if not name or "::" in name:
+        return False
+    return not any(name.startswith(p) for p in _NOISE_PREFIXES)
+
+
+def _merged_busy_us(intervals: List[Tuple[float, float]]) -> float:
+    """Total covered time of possibly-overlapping intervals."""
+    if not intervals:
+        return 0.0
+    intervals.sort()
+    busy = 0.0
+    cur_start, cur_end = intervals[0]
+    for start, end in intervals[1:]:
+        if start > cur_end:
+            busy += cur_end - cur_start
+            cur_start, cur_end = start, end
+        else:
+            cur_end = max(cur_end, end)
+    return busy + (cur_end - cur_start)
+
+
+def parse_trace_file(path: Any) -> Dict[str, Any]:
+    """One trace file → op events, scope events and lane metadata.
+
+    Returns ``{processes, threads, ops, scopes, t_min_us, t_max_us}``
+    where ``ops`` are ``{name, hlo_module, ts, dur, lane}`` and ``scopes``
+    ``{name, ts, dur, lane, step_num?}`` (times in µs)."""
+    path = Path(path)
+    doc = _load_trace_json(path)
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise CaptureError(f"{path}: no traceEvents array")
+
+    processes: Dict[int, str] = {}
+    threads: Dict[Tuple[int, int], str] = {}
+    ops: List[Dict[str, Any]] = []
+    scopes: List[Dict[str, Any]] = []
+    t_min: Optional[float] = None
+    t_max: Optional[float] = None
+
+    for ev in events:
+        if not isinstance(ev, dict) or not ev:
+            continue  # the trailing {} sentinel jax writes
+        ph = ev.get("ph")
+        args = ev.get("args") or {}
+        if ph == "M":
+            if ev.get("name") == "process_name" and "name" in args:
+                processes[int(ev.get("pid", 0))] = str(args["name"])
+            elif ev.get("name") == "thread_name" and "name" in args:
+                threads[(int(ev.get("pid", 0)), int(ev.get("tid", 0)))] = str(args["name"])
+            continue
+        if ph != "X":
+            continue
+        try:
+            ts = float(ev["ts"])
+            dur = float(ev.get("dur", 0.0))
+        except (KeyError, TypeError, ValueError):
+            continue
+        t_min = ts if t_min is None else min(t_min, ts)
+        t_max = ts + dur if t_max is None else max(t_max, ts + dur)
+        lane = (int(ev.get("pid", 0)), int(ev.get("tid", 0)))
+        name = str(ev.get("name", ""))
+        if "hlo_op" in args or "hlo_module" in args:
+            ops.append(
+                {
+                    "name": str(args.get("hlo_op") or name),
+                    "hlo_module": str(args.get("hlo_module") or ""),
+                    "ts": ts,
+                    "dur": dur,
+                    "lane": lane,
+                }
+            )
+        elif _is_scope_name(name):
+            scope: Dict[str, Any] = {"name": name, "ts": ts, "dur": dur, "lane": lane}
+            if "step_num" in args:
+                try:
+                    scope["step_num"] = int(args["step_num"])
+                except (TypeError, ValueError):
+                    pass
+            scopes.append(scope)
+
+    return {
+        "path": str(path),
+        "processes": processes,
+        "threads": threads,
+        "ops": ops,
+        "scopes": scopes,
+        "t_min_us": t_min or 0.0,
+        "t_max_us": t_max or 0.0,
+    }
+
+
+def _attribute_scope(op: Dict[str, Any], scopes: List[Dict[str, Any]]) -> str:
+    """The innermost scope whose interval contains the op's midpoint
+    (scopes nest — `my_scope` inside the `train` step annotation — so the
+    tightest containing interval is the most specific attribution)."""
+    mid = op["ts"] + op["dur"] / 2.0
+    best: Optional[Dict[str, Any]] = None
+    for s in scopes:
+        if s["ts"] <= mid <= s["ts"] + s["dur"]:
+            if best is None or s["dur"] < best["dur"]:
+                best = s
+    return best["name"] if best is not None else ""
+
+
+def summarize_capture(capture_dir: Any, top_k: int = 15) -> Dict[str, Any]:
+    """Aggregate every trace file of one capture dir into the report the
+    CLI renders: per-op device-time table (scope-attributed), per-scope
+    device share, and device-busy/idle fractions per capture window."""
+    files = find_trace_files(capture_dir)
+    if not files:
+        raise CaptureError(f"no *.trace.json(.gz) under {capture_dir}")
+
+    op_rows: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    scope_us: Dict[str, float] = {}
+    windows: List[Dict[str, Any]] = []
+    steps: set = set()
+    total_busy = 0.0
+    total_window = 0.0
+
+    for path in files:
+        parsed = parse_trace_file(path)
+        scopes = parsed["scopes"]
+        for s in scopes:
+            if "step_num" in s:
+                steps.add(s["step_num"])
+        lane_intervals: Dict[Tuple[int, int], List[Tuple[float, float]]] = {}
+        for op in parsed["ops"]:
+            lane_intervals.setdefault(op["lane"], []).append(
+                (op["ts"], op["ts"] + op["dur"])
+            )
+            scope = _attribute_scope(op, scopes)
+            key = (op["name"], op["hlo_module"])
+            row = op_rows.setdefault(
+                key,
+                {
+                    "op": op["name"],
+                    "hlo_module": op["hlo_module"],
+                    "count": 0,
+                    "total_us": 0.0,
+                    "scopes": {},
+                },
+            )
+            row["count"] += 1
+            row["total_us"] += op["dur"]
+            row["scopes"][scope] = row["scopes"].get(scope, 0.0) + op["dur"]
+            scope_us[scope] = scope_us.get(scope, 0.0) + op["dur"]
+
+        busy = sum(_merged_busy_us(iv) for iv in lane_intervals.values())
+        window = max(0.0, parsed["t_max_us"] - parsed["t_min_us"])
+        # idle is measured against the capture window × device lanes — a
+        # device lane idle while python runs is genuine idle
+        lanes = max(1, len(lane_intervals))
+        total_busy += busy
+        total_window += window * lanes
+        windows.append(
+            {
+                "file": parsed["path"],
+                "host": next(iter(parsed["processes"].values()), ""),
+                "device_lanes": len(lane_intervals),
+                "window_us": round(window, 3),
+                "device_busy_us": round(busy, 3),
+                "device_idle_frac": round(1.0 - busy / (window * lanes), 4)
+                if window > 0
+                else None,
+            }
+        )
+
+    busy_total = sum(r["total_us"] for r in op_rows.values()) or 1.0
+    ops = sorted(op_rows.values(), key=lambda r: -r["total_us"])
+    table = []
+    for row in ops[: max(0, int(top_k))]:
+        dominant = max(row["scopes"].items(), key=lambda kv: kv[1])[0] if row["scopes"] else ""
+        table.append(
+            {
+                "op": row["op"],
+                "hlo_module": row["hlo_module"],
+                "count": row["count"],
+                "total_us": round(row["total_us"], 3),
+                "frac": round(row["total_us"] / busy_total, 4),
+                "scope": dominant,
+            }
+        )
+    scopes_out = {
+        (name or "(unscoped)"): {
+            "device_us": round(us, 3),
+            "frac": round(us / busy_total, 4),
+        }
+        for name, us in sorted(scope_us.items(), key=lambda kv: -kv[1])
+    }
+    return {
+        "capture_dir": str(capture_dir),
+        "files": len(files),
+        "windows": windows,
+        "device_busy_us": round(total_busy, 3),
+        "device_idle_frac": round(1.0 - total_busy / total_window, 4)
+        if total_window > 0
+        else None,
+        "steps": sorted(steps),
+        "ops": table,
+        "op_kinds": len(op_rows),
+        "scopes": scopes_out,
+    }

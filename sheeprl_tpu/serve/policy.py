@@ -374,6 +374,49 @@ class InferencePolicy:
     def retraces_since_warmup(self) -> int:
         return max(0, RETRACE_DETECTOR.trace_count(self._tag) - self._traces_at_warmup)
 
+    def roofline_records(self) -> list:
+        """One roofline verdict per compiled bucket (greedy variant): XLA
+        cost analysis of the bucketed apply vs this device's roof. Serving
+        is almost always memory-bound at bucket size 1 and climbs toward the
+        ridge as occupancy grows — this quantifies exactly how much roof a
+        fuller bucket buys. Best-effort: returns [] on backends without cost
+        analysis."""
+        from ..telemetry.throughput import (
+            cost_of_lowered,
+            peak_bytes_per_s_record,
+            peak_flops_record,
+            roofline_record,
+        )
+
+        out: list = []
+        try:
+            import jax
+
+            device = jax.devices()[0]
+            params, _ = self.current_params()
+            flops_rec = peak_flops_record(device)
+            bw_rec = peak_bytes_per_s_record(device)
+            for b in self.buckets:
+                obs = self.core.dummy_obs(b)
+                state = None
+                if self.core.stateful:
+                    state = self._stack_rows([self._init_row] * b)
+                lowered = self._jit_variants[True].lower(params, obs, state, self._key)
+                rec = roofline_record(
+                    f"{self.core.name}_apply_b{b}",
+                    cost_of_lowered(lowered),
+                    peak_flops=flops_rec.get("peak_flops"),
+                    peak_bytes_per_s=bw_rec.get("peak_bytes_per_s"),
+                    device_kind=str(getattr(device, "device_kind", "") or ""),
+                    basis=str(bw_rec.get("peak_bytes_per_s_basis") or ""),
+                    role="replica",
+                )
+                if rec is not None:
+                    out.append(rec)
+        except Exception:
+            return out
+        return out
+
     # -- the act path ------------------------------------------------------
     def prepare(self, raw_obs: Dict[str, Any], n: int = 1) -> Any:
         return self.core.prepare(raw_obs, n)

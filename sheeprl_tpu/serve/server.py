@@ -245,6 +245,15 @@ class PolicyServer:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "PolicyServer":
         self.batcher.start()
+        if self.sink is not None:
+            # per-bucket roofline verdicts of the warmed apply fn: bucket
+            # size 1 sits deepest in memory-bound territory, the largest
+            # bucket shows what full occupancy buys — written once, at start
+            try:
+                for rec in self.policy.roofline_records():
+                    self.sink.write(rec)
+            except Exception:
+                pass
         if self.reloader is not None:
             self.reloader.start()
         if self.http_enabled and self._httpd is None:

@@ -27,10 +27,17 @@ from typing import Any, Dict, Optional
 
 from ..utils.metric import MetricAggregator
 from . import xla as _xla
+from .memory import MemorySampler, host_rss_bytes, memory_snapshot
 from .sinks import DEFAULT_JSONL_MAX_BYTES, ConsoleHeartbeat, JsonlSink
 from .spans import GLOBAL_TRACKER, Span, SpanTracker
 from .schema import SCHEMA_VERSION
-from .throughput import ThroughputTracker, peak_flops_record
+from .throughput import (
+    ThroughputTracker,
+    cost_of_lowered,
+    peak_bytes_per_s_record,
+    peak_flops_record,
+    roofline_record,
+)
 
 
 def _device_info() -> Dict[str, Any]:
@@ -86,6 +93,10 @@ class Telemetry:
             world_size=int(sel("fabric.devices", 1) or 1),
             algo=str(sel("algo.name", "") or ""),
             run_name=str(sel("run_name", "") or ""),
+            # host RSS on the heartbeat: on CPU-only backends this is the
+            # only memory figure the run has, and its absence used to read
+            # as "memory telemetry not wired" rather than "no accelerator"
+            rss_bytes=host_rss_bytes(),
         )
 
         # sinks — JSONL only on rank 0 (one stream per run, not per host);
@@ -183,8 +194,29 @@ class Telemetry:
         # XLA health baselines: report per-run deltas of process-wide counters
         self._xla0 = _xla.compile_counters()
         self._xla_last = dict(self._xla0)
+        self._breakdown0 = _xla.compile_breakdown()
         self._retrace0 = self.detector.retrace_count()
         self._attr_seen = len(self.detector.attribution())
+
+        # roofline registrations (per jitted fn) and the lazily-measured
+        # device peaks they classify against (the CPU bandwidth measurement
+        # costs ~0.1 s — paid once, on the first registration)
+        self._rooflines: Dict[str, Dict[str, Any]] = {}
+        self._roofline_peaks: Optional[Dict[str, Any]] = None
+
+        # cadenced memory sampling on the learner's own stream: host RSS
+        # always (the CPU container still grows a watermark series), HBM
+        # stats where the backend reports them
+        self._mem_sampler: Optional[MemorySampler] = None
+        self._last_step = 0
+        if self.enabled and self.rank == 0 and bool(dsel("diag.mem.enabled", True)):
+            self._mem_sampler = MemorySampler(
+                self._emit,
+                role="learner",
+                interval_s=float(dsel("diag.mem.interval_s", 5.0) or 5.0),
+                census_every=int(dsel("diag.mem.census_every", 6) or 0),
+                step_fn=lambda: self._last_step,
+            ).start()
 
         self._transfers: Optional[_xla.TransferCounter] = None
         if self.enabled and bool(sel("metric.telemetry.transfer_counter", True)):
@@ -362,6 +394,90 @@ class Telemetry:
         and attributed (see `telemetry.xla.RetraceDetector`)."""
         return self.detector.wrap(fn, name)
 
+    # -- roofline ----------------------------------------------------------
+    def _peaks(self) -> Dict[str, Any]:
+        if self._roofline_peaks is None:
+            try:
+                import jax
+
+                dev = jax.devices()[0]
+                fr = peak_flops_record(dev)
+                br = peak_bytes_per_s_record(dev)
+                self._roofline_peaks = {
+                    "peak_flops": fr.get("peak_flops"),
+                    "peak_bytes_per_s": br.get("peak_bytes_per_s"),
+                    "basis": str(br.get("peak_bytes_per_s_basis") or ""),
+                    "device_kind": str(getattr(dev, "device_kind", "")),
+                    "n_devices": int(jax.device_count()),
+                }
+            except Exception:
+                self._roofline_peaks = {}
+        return self._roofline_peaks
+
+    def register_roofline(
+        self,
+        name: str,
+        lowered: Any = None,
+        cost: Optional[Dict[str, float]] = None,
+        role: str = "learner",
+        track_grad_rate: bool = False,
+    ) -> Optional[Dict[str, Any]]:
+        """Register a jitted fn's XLA cost (flops + bytes_accessed, from
+        `jit(...).lower(...)` or a precomputed cost dict) and emit its
+        roofline verdict. With ``track_grad_rate=True`` the verdict is
+        re-emitted each log interval with the measured grad-step rate as
+        `calls_per_s` — the attained-fraction-of-roof series for the train
+        step. Returns the emitted record (None when the cost analysis
+        lacked either axis)."""
+        if not self.enabled:
+            return None
+        if cost is None and lowered is not None:
+            cost = cost_of_lowered(lowered)
+        if not cost:
+            return None
+        peaks = self._peaks()
+        rec = roofline_record(
+            name,
+            cost,
+            peak_flops=peaks.get("peak_flops"),
+            peak_bytes_per_s=peaks.get("peak_bytes_per_s"),
+            n_devices=peaks.get("n_devices", 1),
+            device_kind=peaks.get("device_kind", ""),
+            basis=peaks.get("basis", ""),
+            role=role,
+        )
+        if rec is None:
+            return None
+        self._rooflines[str(name)] = {
+            "cost": dict(cost),
+            "role": str(role),
+            "track_grad_rate": bool(track_grad_rate),
+        }
+        self._emit(rec)
+        return rec
+
+    def _emit_tracked_rooflines(self, policy_step: int, calls_per_s: float) -> None:
+        if calls_per_s <= 0:
+            return
+        peaks = self._peaks()
+        for name, info in self._rooflines.items():
+            if not info.get("track_grad_rate"):
+                continue
+            rec = roofline_record(
+                name,
+                info["cost"],
+                peak_flops=peaks.get("peak_flops"),
+                peak_bytes_per_s=peaks.get("peak_bytes_per_s"),
+                calls_per_s=calls_per_s,
+                n_devices=peaks.get("n_devices", 1),
+                device_kind=peaks.get("device_kind", ""),
+                basis=peaks.get("basis", ""),
+                role=info["role"],
+            )
+            if rec is not None:
+                rec["step"] = int(policy_step)
+                self._emit(rec)
+
     # -- health snapshots --------------------------------------------------
     def xla_health(self) -> Dict[str, Any]:
         now = _xla.compile_counters()
@@ -371,8 +487,27 @@ class Telemetry:
             "jaxpr_traces": now["jaxpr_trace_count"] - self._xla0["jaxpr_trace_count"],
             "compiles_in_interval": now["compile_count"] - self._xla_last["compile_count"],
             "retraces": self.detector.retrace_count() - self._retrace0,
+            # persistent-compilation-cache accounting (per-run deltas): a
+            # hit is a backend compile some earlier run already paid for
+            "cache_hits": int(now.get("cache_hits", 0) - self._xla0.get("cache_hits", 0)),
+            "cache_misses": int(now.get("cache_misses", 0) - self._xla0.get("cache_misses", 0)),
         }
         self._xla_last = now
+        # per-function compile-seconds breakdown (worst offenders named):
+        # this run's delta against the setup-time snapshot, heaviest first
+        breakdown: Dict[str, Dict[str, float]] = {}
+        for tag, slot in _xla.compile_breakdown().items():
+            base = self._breakdown0.get(tag, {"count": 0, "seconds": 0.0})
+            count = int(slot["count"] - base["count"])
+            if count > 0:
+                breakdown[tag] = {
+                    "count": count,
+                    "seconds": round(slot["seconds"] - base["seconds"], 4),
+                }
+        if breakdown:
+            out["compile_breakdown"] = dict(
+                sorted(breakdown.items(), key=lambda kv: -kv[1]["seconds"])[:8]
+            )
         attribution = self.detector.attribution()
         if len(attribution) > self._attr_seen:
             out["retrace_attribution"] = attribution[self._attr_seen :]
@@ -400,7 +535,11 @@ class Telemetry:
         interval_steps = tp.pop("interval_steps", 0)
         tp_seconds = tp.pop("interval_seconds", 0.0)
         xla_health = self.xla_health()
-        memory = _xla.device_memory_stats()
+        # host RSS always + HBM stats where the backend has them: on
+        # CPU-only containers device_memory_stats() is {} and the log
+        # record used to carry no memory fields at all
+        memory = memory_snapshot()
+        self._last_step = int(policy_step)
 
         scalars: Dict[str, float] = dict(metrics)
         scalars["Time/sps"] = tp["sps"]
@@ -441,8 +580,11 @@ class Telemetry:
             "memory": memory,
         }
         self._emit(rec)
+        # tracked rooflines (the train step): refine the verdict with this
+        # interval's measured grad-step rate → attained fraction of roof
+        self._emit_tracked_rooflines(int(policy_step), float(tp.get("grad_steps_per_s") or 0.0))
         if self.rank == 0:  # startup prints per host; interval lines rank-0 only
-            self.heartbeat.log(int(policy_step), {**tp, "xla": xla_health})
+            self.heartbeat.log(int(policy_step), {**tp, "xla": xla_health, "memory": memory})
         return rec
 
     # -- shutdown ----------------------------------------------------------
@@ -460,6 +602,10 @@ class Telemetry:
             except Exception:
                 pass
             self._tracing = False
+        if self._mem_sampler is not None:
+            # the closing sample pins the run's memory high-water on stream
+            self._mem_sampler.stop()
+            self._mem_sampler = None
         if self.enabled:
             self._emit(
                 {

@@ -1,0 +1,239 @@
+"""Host + device memory observation: RSS, HBM stats, live-buffer census,
+and the cadenced :class:`MemorySampler` that turns them into schema'd
+``mem`` events on every process stream.
+
+The host side is dependency-free by design: ``/proc/self/status`` first
+(Linux — the containers this stack runs in), ``resource.getrusage`` as the
+portable fallback. The device side reuses the guarded
+``telemetry.xla.device_memory_stats`` (``{}`` on CPU backends), so the
+*sampler* always has something to say — host RSS is the required field of
+every ``mem`` event precisely because the CPU container must still grow a
+watermark series (the hbm fields appear only where a real accelerator
+reports them).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from . import xla as _xla
+
+__all__ = [
+    "MemorySampler",
+    "host_rss_bytes",
+    "host_rss_peak_bytes",
+    "live_buffer_census",
+    "memory_snapshot",
+    "start_sampler",
+]
+
+_PAGE = 4096  # only used if a /proc read ever returns pages (it doesn't)
+
+
+def _proc_status_kib(field: str) -> Optional[int]:
+    """One `VmRSS:`-style field of /proc/self/status, in KiB, or None."""
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith(field + ":"):
+                    return int(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def host_rss_bytes() -> int:
+    """This process's resident set size in bytes (0 only if every source
+    fails — the value is load-bearing for the `mem` schema, never None)."""
+    kib = _proc_status_kib("VmRSS")
+    if kib is not None:
+        return kib * 1024
+    try:
+        import resource
+
+        # ru_maxrss is KB on Linux, bytes on macOS; either way it is a
+        # high-water, the best available stand-in where /proc is absent
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(ru) * (1 if ru > 1 << 32 else 1024)
+    except Exception:
+        return 0
+
+
+def host_rss_peak_bytes() -> int:
+    """The kernel's RSS high-water mark (VmHWM) in bytes; 0 when unknown."""
+    kib = _proc_status_kib("VmHWM")
+    if kib is not None:
+        return kib * 1024
+    try:
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(ru) * (1 if ru > 1 << 32 else 1024)
+    except Exception:
+        return 0
+
+
+def live_buffer_census(backend: Any = None) -> Dict[str, int]:
+    """Count + total bytes of live device arrays (`jax.live_arrays`).
+
+    This walks every live buffer — cheap at normal buffer counts, but not
+    free, which is why the sampler only runs it every Nth tick."""
+    try:
+        import jax
+
+        arrays = jax.live_arrays() if backend is None else jax.live_arrays(backend)
+        total = 0
+        for a in arrays:
+            total += int(getattr(a, "nbytes", 0) or 0)
+        return {"live_buffers": len(arrays), "live_buffer_bytes": total}
+    except Exception:
+        return {}
+
+
+def memory_snapshot(device: Any = None, census: bool = False) -> Dict[str, int]:
+    """One combined host+device memory observation.
+
+    Always contains ``rss_bytes`` (and ``rss_peak_bytes`` when the kernel
+    reports it); adds the hbm_* fields on backends with `memory_stats()`
+    and the live-buffer census when asked for."""
+    out: Dict[str, int] = {"rss_bytes": host_rss_bytes()}
+    peak = host_rss_peak_bytes()
+    if peak:
+        out["rss_peak_bytes"] = peak
+    dev = _xla.device_memory_stats(device)
+    if dev.get("bytes_in_use") is not None:
+        out["hbm_bytes_in_use"] = int(dev["bytes_in_use"])
+    if dev.get("peak_bytes_in_use") is not None:
+        out["hbm_peak_bytes"] = int(dev["peak_bytes_in_use"])
+    if dev.get("bytes_limit") is not None:
+        out["hbm_bytes_limit"] = int(dev["bytes_limit"])
+    if census:
+        out.update(live_buffer_census())
+    return out
+
+
+class MemorySampler:
+    """Background thread emitting one schema'd ``mem`` event per cadence
+    tick on the owning process's telemetry stream.
+
+    Designed for the five stream types the stack runs (learner facade,
+    fleet workers, remote workers, gateway replicas, brokerd): pass the
+    stream's ``emit`` callable, the role label and the slot index; `start()`
+    spawns a daemon thread, `stop()` joins it (both idempotent). The census
+    (a walk over every live device array) runs only every
+    ``census_every``-th sample. ``sample_once()`` is the synchronous form —
+    tests and short-lived processes can emit a sample without a thread."""
+
+    def __init__(
+        self,
+        emit: Callable[[Dict[str, Any]], None],
+        role: str,
+        index: Optional[int] = None,
+        interval_s: float = 5.0,
+        census_every: int = 6,
+        step_fn: Optional[Callable[[], int]] = None,
+    ) -> None:
+        self.emit = emit
+        self.role = str(role)
+        self.index = index
+        self.interval_s = max(0.05, float(interval_s))
+        self.census_every = max(0, int(census_every))
+        self._step_fn = step_fn
+        self._ticks = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # process-local high-waters (device peak_bytes_in_use is the
+        # allocator's own high-water; these cover the host side and
+        # backends whose stats lack a peak)
+        self.rss_high_water = 0
+        self.hbm_high_water = 0
+
+    def sample_once(self) -> Dict[str, Any]:
+        """Take one sample, emit it, return the record."""
+        census = self.census_every > 0 and self._ticks % self.census_every == 0
+        self._ticks += 1
+        snap = memory_snapshot(census=census)
+        self.rss_high_water = max(self.rss_high_water, snap.get("rss_bytes", 0))
+        if snap.get("hbm_bytes_in_use") is not None:
+            self.hbm_high_water = max(self.hbm_high_water, snap["hbm_bytes_in_use"])
+        rec: Dict[str, Any] = {
+            "event": "mem",
+            "role": self.role,
+            "rss_bytes": int(snap.get("rss_bytes", 0)),
+            "t": round(time.time(), 3),
+        }
+        for key in (
+            "rss_peak_bytes",
+            "hbm_bytes_in_use",
+            "hbm_peak_bytes",
+            "hbm_bytes_limit",
+            "live_buffers",
+            "live_buffer_bytes",
+        ):
+            if key in snap:
+                rec[key] = int(snap[key])
+        if self.index is not None:
+            rec["index"] = int(self.index)
+            # role-named slot fields are what the diag joiners key on
+            if self.role == "worker":
+                rec["worker"] = int(self.index)
+            elif self.role == "replica":
+                rec["replica"] = int(self.index)
+        if self._step_fn is not None:
+            try:
+                rec["step"] = int(self._step_fn())
+            except Exception:
+                pass
+        try:
+            self.emit(rec)
+        except Exception:
+            pass  # a torn sink must never take the sampled process down
+        return rec
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample_once()
+
+    def start(self) -> "MemorySampler":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name=f"mem-sampler-{self.role}", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, final_sample: bool = True) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+        if final_sample:
+            # the closing sample pins the high-water the stream reports
+            self.sample_once()
+
+
+def start_sampler(
+    cfg: Any,
+    emit: Callable[[Dict[str, Any]], None],
+    role: str,
+    index: Optional[int] = None,
+    step_fn: Optional[Callable[[], int]] = None,
+) -> Optional[MemorySampler]:
+    """Config-gated sampler construction (diag.mem.*): returns a STARTED
+    sampler, or None when sampling is disabled. `cfg` may be a run config,
+    a diag config or None (code defaults)."""
+    sel = cfg.select if cfg is not None and hasattr(cfg, "select") else (lambda p, d=None: d)
+    if not bool(sel("diag.mem.enabled", True)):
+        return None
+    sampler = MemorySampler(
+        emit,
+        role,
+        index=index,
+        interval_s=float(sel("diag.mem.interval_s", 5.0) or 5.0),
+        census_every=int(sel("diag.mem.census_every", 6) or 0),
+        step_fn=step_fn,
+    )
+    return sampler.start()

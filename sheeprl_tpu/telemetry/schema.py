@@ -42,6 +42,9 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "incarnation": (False, _NUM),
         "worker": (False, _NUM),
         "replica": (False, _NUM),
+        # host RSS at startup: every heartbeat carries a memory datum even
+        # on CPU-only backends where device_memory_stats() is empty
+        "rss_bytes": (False, _NUM),
     },
     # one per log interval
     "log": {
@@ -78,6 +81,10 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "wall_capped": (False, bool),
         "mfu": (False, _NUM),
         "preflight_attempts": (False, _NUM),
+        # run-wide memory high-waters (informational context for the
+        # real-TPU rounds, not gated — like binding_stage)
+        "peak_rss_bytes": (False, _NUM),
+        "device_peak_bytes": (False, _NUM),
     },
     # bench pacing/diagnostic lines (stderr)
     "bench_progress": {
@@ -527,6 +534,9 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "broker": (False, _DICT),
         "broker_recovery_s": (False, _NUM),
         "broker_repl_lag_p95_ms": (False, _NUM),
+        # driver-process memory high-waters (informational, like binding_stage)
+        "peak_rss_bytes": (False, _NUM),
+        "device_peak_bytes": (False, _NUM),
     },
     # data-flywheel end-to-end bench record (scripts/bench_flywheel.py ->
     # FLYWHEEL_r*.json): one full serve -> capture -> ingest -> fine-tune ->
@@ -565,6 +575,56 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Tuple[bool, type]]] = {
         "acked": (False, _NUM),
         "duration_s": (False, _NUM),
         "platform": (False, _STR),
+        # driver-process memory high-waters (informational, like binding_stage)
+        "peak_rss_bytes": (False, _NUM),
+        "device_peak_bytes": (False, _NUM),
+    },
+    # cadenced memory sample (telemetry/memory.py MemorySampler): host RSS
+    # always — the CPU container must still grow a watermark series — plus
+    # device HBM stats when the backend reports them and an optional
+    # live-buffer census. Emitted on every process stream (learner, fleet
+    # workers, replicas, brokerd; relayed like any other event), read by
+    # doctor's hbm_pressure / host_mem_leak findings, `sheeprl_tpu top`'s
+    # memory columns and the Prometheus gauges.
+    "mem": {
+        "role": (True, _STR),
+        "rss_bytes": (True, _NUM),
+        "t": (False, _NUM),
+        "step": (False, _NUM),
+        "rss_peak_bytes": (False, _NUM),
+        "hbm_bytes_in_use": (False, _NUM),
+        "hbm_peak_bytes": (False, _NUM),
+        "hbm_bytes_limit": (False, _NUM),
+        "live_buffers": (False, _NUM),
+        "live_buffer_bytes": (False, _NUM),
+        "worker": (False, _NUM),
+        "replica": (False, _NUM),
+        "index": (False, _NUM),
+    },
+    # roofline verdict for one jitted fn (telemetry/throughput.py
+    # roofline_record): arithmetic intensity (flops / bytes_accessed from
+    # XLA cost analysis) against the device's peak-FLOP/s and peak-HBM-
+    # bandwidth tables → compute- vs memory-bound, with the attained
+    # fraction of the bounding roof once a measured call rate is known.
+    # `fn` is a label (Prometheus roofline_attained_frac{fn=...}) — low
+    # cardinality by construction: train_step + one name per serve bucket.
+    "roofline": {
+        "fn": (True, _STR),
+        "flops": (True, _NUM),
+        "bytes_accessed": (True, _NUM),
+        "intensity": (True, _NUM),
+        "bound": (True, _STR),  # compute | memory | unknown
+        "ridge_intensity": (False, _NUM),
+        "peak_flops": (False, _NUM),
+        "peak_bytes_per_s": (False, _NUM),
+        "attained_frac": (False, _NUM),
+        "attained_flops_per_s": (False, _NUM),
+        "calls_per_s": (False, _NUM),
+        "device_kind": (False, _STR),
+        "basis": (False, _STR),
+        "role": (False, _STR),
+        "step": (False, _NUM),
+        "t": (False, _NUM),
     },
     # relay sink flush accounting (telemetry/relay.py): one per flush
     # cadence on the EMITTING process's own stream. `sent`/`dropped` are

@@ -187,4 +187,13 @@ class ConsoleHeartbeat:
             parts.append(f"compiles={int(xla['compile_count'])}")
         if xla.get("retraces"):
             parts.append(f"retraces={int(xla['retraces'])}")
+        # persistent-compilation-cache accounting: a hit is a compile some
+        # earlier run already paid for; misses are this run's cold compiles
+        if xla.get("cache_hits") or xla.get("cache_misses"):
+            parts.append(f"cache={int(xla.get('cache_hits') or 0)}h/{int(xla.get('cache_misses') or 0)}m")
+        mem = fields.get("memory") or {}
+        if mem.get("rss_bytes"):
+            parts.append(f"rss={int(mem['rss_bytes']) >> 20}MiB")
+        if mem.get("hbm_bytes_in_use"):
+            parts.append(f"hbm={int(mem['hbm_bytes_in_use']) >> 20}MiB")
         print(f"[telemetry rank={self.rank}] " + " ".join(parts), file=self._out(), flush=True)

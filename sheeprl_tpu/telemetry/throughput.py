@@ -27,15 +27,41 @@ PEAK_FLOPS: Dict[str, float] = {
 }
 
 
+# peak HBM bandwidth per chip (bytes/s) by device kind — the other axis of
+# the roofline. Vendor figures; same substring-match convention as
+# PEAK_FLOPS (longest key first).
+PEAK_BYTES_PER_S: Dict[str, float] = {
+    "trillium": 1640e9,
+    "v6e": 1640e9,  # Trillium
+    "v6": 1640e9,
+    "v5p": 2765e9,
+    "v5e": 819e9,
+    "v5 lite": 819e9,
+    "v5litepod": 819e9,
+    "v4": 1228e9,
+    "v3": 900e9,
+    "v2": 700e9,
+}
+
+
+def _table_lookup(table: Dict[str, float], device: Any) -> Optional[float]:
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for sub in sorted(table, key=len, reverse=True):
+        if sub in kind:
+            return table[sub]
+    return None
+
+
 def peak_flops_for(device: Any) -> Optional[float]:
     """Vendor bf16 peak FLOP/s for a device, by `device_kind` substring
     (longest match wins — "v5e" must not resolve through a bare "v5"-style
     prefix if one is ever added)."""
-    kind = (getattr(device, "device_kind", "") or "").lower()
-    for sub in sorted(PEAK_FLOPS, key=len, reverse=True):
-        if sub in kind:
-            return PEAK_FLOPS[sub]
-    return None
+    return _table_lookup(PEAK_FLOPS, device)
+
+
+def peak_bytes_per_s_for(device: Any) -> Optional[float]:
+    """Vendor peak HBM bytes/s for a device (same matching as PEAK_FLOPS)."""
+    return _table_lookup(PEAK_BYTES_PER_S, device)
 
 
 def measured_cpu_peak_flops() -> float:
@@ -60,23 +86,34 @@ def measured_cpu_peak_flops() -> float:
     return 2 * n**3 / min(_one() for _ in range(5))
 
 
-def flops_of_lowered(lowered: Any) -> Optional[float]:
-    """Model FLOPs per call from `jit(...).lower(...)`: try the cheap
-    pre-compile `cost_analysis()`, fall back to compiling (some backends only
-    report costs on the executable — the persistent compilation cache makes
-    that a one-time price)."""
+def cost_of_lowered(lowered: Any) -> Dict[str, float]:
+    """FLOPs *and* bytes-accessed per call from `jit(...).lower(...)`:
+    try the cheap pre-compile `cost_analysis()`, fall back to compiling
+    (some backends only report costs on the executable — the persistent
+    compilation cache makes that a one-time price). XLA spells the traffic
+    key "bytes accessed" (with a space); returned here as `bytes_accessed`.
+    Missing quantities are simply absent from the result."""
+    out: Dict[str, float] = {}
     try:
-        ca = lowered.cost_analysis()
-        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        if ca and ca.get("flops"):
-            return float(ca["flops"])
-        ca = lowered.compile().cost_analysis()
-        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        if ca and ca.get("flops"):
-            return float(ca["flops"])
+        for stage in (lowered, None):
+            ca = (stage or lowered.compile()).cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            if ca:
+                if ca.get("flops") and "flops" not in out:
+                    out["flops"] = float(ca["flops"])
+                if ca.get("bytes accessed") and "bytes_accessed" not in out:
+                    out["bytes_accessed"] = float(ca["bytes accessed"])
+            if "flops" in out and "bytes_accessed" in out:
+                break
     except Exception:
         pass
-    return None
+    return out
+
+
+def flops_of_lowered(lowered: Any) -> Optional[float]:
+    """Model FLOPs per call from `jit(...).lower(...)` (see
+    `cost_of_lowered` for the full flops+bytes record)."""
+    return cost_of_lowered(lowered).get("flops")
 
 
 def mfu(flops_per_step: float, steps_per_sec: float, peak_flops: float, n_devices: int = 1) -> float:
@@ -86,8 +123,115 @@ def mfu(flops_per_step: float, steps_per_sec: float, peak_flops: float, n_device
     return flops_per_step * steps_per_sec / (peak_flops * max(1, n_devices))
 
 
+def measured_cpu_peak_bytes_per_s() -> float:
+    """Achievable memory bytes/s on the host CPU backend, measured with a
+    jitted 64 MiB f32 element-wise add (best of 5; read + write counted) —
+    the roofline bandwidth denominator on fallback runs, labeled as
+    measured. CPU-only for the same reason as `measured_cpu_peak_flops`."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 16 * 1024 * 1024  # 64 MiB of f32 — larger than any host LLC
+    x = jnp.ones((n,), jnp.float32)
+    f = jax.jit(lambda a: a + 1.0)
+    jax.block_until_ready(f(x))
+
+    def _one() -> float:
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(x))
+        return time.perf_counter() - t0
+
+    return 2 * x.nbytes / min(_one() for _ in range(5))
+
+
 _VENDOR_BASIS = "vendor bf16 peak by device_kind"
 _CPU_MEASURED_BASIS = "measured 1024^3 f32 matmul on cpu (not vendor peak)"
+_VENDOR_BW_BASIS = "vendor peak HBM bandwidth by device_kind"
+_CPU_MEASURED_BW_BASIS = "measured 64MiB f32 stream on cpu (not vendor peak)"
+
+
+def peak_bytes_per_s_record(device: Any, allow_cpu_measure: bool = True) -> Dict[str, Any]:
+    """{peak_bytes_per_s, peak_bytes_per_s_basis} for a device — vendor
+    table first, measured host stream on CPU, neither on unknown
+    accelerators (the bandwidth twin of `peak_flops_record`)."""
+    peak = peak_bytes_per_s_for(device)
+    if peak is not None:
+        return {"peak_bytes_per_s": peak, "peak_bytes_per_s_basis": _VENDOR_BW_BASIS}
+    if getattr(device, "platform", "") == "cpu":
+        if allow_cpu_measure:
+            return {
+                "peak_bytes_per_s": measured_cpu_peak_bytes_per_s(),
+                "peak_bytes_per_s_basis": _CPU_MEASURED_BW_BASIS,
+            }
+        return {
+            "peak_bytes_per_s": None,
+            "peak_bytes_per_s_basis": "cpu stream measurement disabled; roofline omitted",
+        }
+    return {
+        "peak_bytes_per_s": None,
+        "peak_bytes_per_s_basis": (
+            f"unknown device_kind {getattr(device, 'device_kind', '')!r}; roofline omitted"
+        ),
+    }
+
+
+def roofline_record(
+    fn: str,
+    cost: Dict[str, float],
+    peak_flops: Optional[float] = None,
+    peak_bytes_per_s: Optional[float] = None,
+    calls_per_s: Optional[float] = None,
+    n_devices: int = 1,
+    device_kind: str = "",
+    basis: str = "",
+    role: Optional[str] = None,
+) -> Optional[Dict[str, Any]]:
+    """One schema'd ``roofline`` event for a jitted fn, or None when the
+    cost analysis lacked either axis.
+
+    Arithmetic intensity = flops / bytes_accessed; the ridge is
+    peak_flops / peak_bytes_per_s — below it the fn cannot reach the
+    compute roof no matter how good the schedule (memory-bound), above it
+    compute is the ceiling. With a measured `calls_per_s`, `attained_frac`
+    is the achieved fraction of the *binding* roof (per chip)."""
+    flops = float(cost.get("flops") or 0.0)
+    bytes_accessed = float(cost.get("bytes_accessed") or 0.0)
+    if flops <= 0.0 or bytes_accessed <= 0.0:
+        return None
+    intensity = flops / bytes_accessed
+    rec: Dict[str, Any] = {
+        "event": "roofline",
+        "fn": str(fn),
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "intensity": round(intensity, 6),
+        "bound": "unknown",
+        "t": round(time.time(), 3),
+    }
+    if device_kind:
+        rec["device_kind"] = str(device_kind)
+    if basis:
+        rec["basis"] = str(basis)
+    if role:
+        rec["role"] = str(role)
+    if peak_flops:
+        rec["peak_flops"] = float(peak_flops)
+    if peak_bytes_per_s:
+        rec["peak_bytes_per_s"] = float(peak_bytes_per_s)
+    if peak_flops and peak_bytes_per_s:
+        ridge = float(peak_flops) / float(peak_bytes_per_s)
+        rec["ridge_intensity"] = round(ridge, 6)
+        rec["bound"] = "memory" if intensity < ridge else "compute"
+        if calls_per_s and calls_per_s > 0:
+            ndev = max(1, int(n_devices))
+            attained = flops * float(calls_per_s) / ndev
+            rec["calls_per_s"] = round(float(calls_per_s), 6)
+            rec["attained_flops_per_s"] = round(attained, 2)
+            # the binding roof at THIS intensity: min(compute roof,
+            # bandwidth roof × intensity)
+            roof = min(float(peak_flops), float(peak_bytes_per_s) * intensity)
+            rec["attained_frac"] = round(attained / roof, 6)
+    return rec
 
 
 def peak_flops_basis_for(device: Any) -> str:

@@ -183,6 +183,7 @@ def open_process_stream(
         os.path.join(sub, "telemetry.jsonl"),
         max_bytes=DEFAULT_JSONL_MAX_BYTES if max_bytes is None else int(max_bytes),
     )
+    from .memory import host_rss_bytes
     from .schema import SCHEMA_VERSION
 
     sink.write(
@@ -196,6 +197,9 @@ def open_process_stream(
             "pid": int(os.getpid()),
             "incarnation": int(incarnation),
             "schema_version": SCHEMA_VERSION,
+            # host RSS at stream open: every heartbeat carries a memory
+            # datum even on CPU-only backends (the mem series baseline)
+            "rss_bytes": host_rss_bytes(),
             **heartbeat_extra,
         }
     )

@@ -20,6 +20,7 @@ Three independent mechanisms, each robust on its own:
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
@@ -28,16 +29,30 @@ _counters: Dict[str, float] = {
     "compile_count": 0,
     "compile_seconds": 0.0,
     "jaxpr_trace_count": 0,
+    # persistent-compilation-cache accounting (utils.enable_compilation_cache):
+    # a hit means a backend compile was paid once on some earlier run/process
+    "cache_hits": 0,
+    "cache_misses": 0,
 }
+# per-function compile-seconds breakdown: tag → {count, seconds}. The tag is
+# whatever the RetraceDetector last saw tracing on the *calling thread* —
+# XLA compiles on the dispatching thread immediately after the jaxpr trace,
+# so the thread-local trace tag names the function each compile belongs to.
+# Compiles from never-instrumented functions land under "<untagged>".
+_compile_breakdown: Dict[str, Dict[str, float]] = {}
 _listener_installed = False
+_tls = threading.local()
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+UNTAGGED = "<untagged>"
 
 
 def _ensure_listener() -> None:
-    """Register the monitoring listener once per process (jax.monitoring has
-    no unregister — the counters are monotonic by design)."""
+    """Register the monitoring listeners once per process (jax.monitoring
+    has no unregister — the counters are monotonic by design)."""
     global _listener_installed
     with _lock:
         if _listener_installed:
@@ -51,10 +66,23 @@ def _ensure_listener() -> None:
                 if name == _COMPILE_EVENT:
                     _counters["compile_count"] += 1
                     _counters["compile_seconds"] += float(secs)
+                    tag = getattr(_tls, "tag", None) or UNTAGGED
+                    slot = _compile_breakdown.setdefault(tag, {"count": 0, "seconds": 0.0})
+                    slot["count"] += 1
+                    slot["seconds"] += float(secs)
                 elif name == _TRACE_EVENT:
                     _counters["jaxpr_trace_count"] += 1
 
         monitoring.register_event_duration_secs_listener(_on_duration)
+
+        def _on_event(name: str, **_kw: Any) -> None:
+            with _lock:
+                if name == _CACHE_HIT_EVENT:
+                    _counters["cache_hits"] += 1
+                elif name == _CACHE_MISS_EVENT:
+                    _counters["cache_misses"] += 1
+
+        monitoring.register_event_listener(_on_event)
     except Exception:
         pass  # very old jax: counters stay at 0 rather than crashing
 
@@ -64,6 +92,15 @@ def compile_counters() -> Dict[str, float]:
     _ensure_listener()
     with _lock:
         return dict(_counters)
+
+
+def compile_breakdown() -> Dict[str, Dict[str, float]]:
+    """Monotonic per-function compile-seconds breakdown (copy). Keys are
+    RetraceDetector tags; compiles no instrumented trace preceded on the
+    same thread fall under ``"<untagged>"``."""
+    _ensure_listener()
+    with _lock:
+        return {tag: dict(slot) for tag, slot in _compile_breakdown.items()}
 
 
 def device_memory_stats(device: Any = None) -> Dict[str, int]:
@@ -126,6 +163,15 @@ class RetraceDetector:
         return traced
 
     def _record(self, tag: str, args: tuple, kwargs: dict) -> None:
+        # mark this thread as "tracing `tag`": the backend compile that
+        # follows (same thread, before any other instrumented trace) gets
+        # its seconds attributed to this tag by the duration listener
+        _tls.tag = tag
+        if getattr(_tls, "suppress_retraces", False):
+            # a diagnostic re-trace (roofline `.lower()` of an already-jitted
+            # fn): keep the compile attribution, skip the retrace ledger so
+            # it never reads as a shape-instability signal
+            return
         try:
             sig = _signature(args, kwargs)
         except Exception:
@@ -185,6 +231,18 @@ RETRACE_DETECTOR = RetraceDetector()
 def instrument(fn: Callable, name: Optional[str] = None) -> Callable:
     """Convenience: wrap `fn` with the process-default RetraceDetector."""
     return RETRACE_DETECTOR.wrap(fn, name)
+
+
+@contextlib.contextmanager
+def suppress_retrace_accounting():
+    """Deliberate diagnostic traces (roofline `.lower()` of an already-jitted
+    fn) inside this context keep their compile-seconds attribution but are
+    not entered in the retrace ledger — they are not shape instability."""
+    _tls.suppress_retraces = True
+    try:
+        yield
+    finally:
+        _tls.suppress_retraces = False
 
 
 class TransferCounter:

@@ -1,0 +1,450 @@
+"""Device-time & memory attribution: the prof capture parser pinned against
+a golden synthetic trace fixture, the `sheeprl_tpu prof` CLI over both the
+fixture and a REAL jax.profiler CPU capture, the cadenced MemorySampler
+(schema'd ``mem`` events, bounded overhead, CPU-only RSS fallback),
+roofline_record classification math, the live aggregator/top memory
+rollups, Prometheus memory + compile-cache families, and doctor red/green
+for hbm_pressure / host_mem_leak / memory_bound."""
+import gzip
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from sheeprl_tpu.diag import Registry, Timeline, diagnose
+from sheeprl_tpu.diag.aggregator import LiveAggregator
+from sheeprl_tpu.prof import (
+    CaptureError,
+    find_trace_files,
+    parse_trace_file,
+    summarize_capture,
+)
+from sheeprl_tpu.prof.cli import main as prof_main
+from sheeprl_tpu.prof.cli import parse_prof_argv, prof_report, render_text
+from sheeprl_tpu.telemetry import memory as mem_mod
+from sheeprl_tpu.telemetry.memory import (
+    MemorySampler,
+    host_rss_bytes,
+    host_rss_peak_bytes,
+    memory_snapshot,
+    start_sampler,
+)
+from sheeprl_tpu.telemetry.schema import validate_event
+from sheeprl_tpu.telemetry.throughput import roofline_record
+
+
+# -- the golden fixture ------------------------------------------------------
+# One device lane (pid 1) with three HLO op events, one host lane (pid 2)
+# with a `train` step annotation (900–2400 µs, step_num 3) nesting a
+# `my_scope` TraceAnnotation (1300–1700 µs), plus runtime-noise events that
+# must be filtered from the scope population. Every expected number below
+# is derived by hand from these intervals.
+_GOLDEN_EVENTS = [
+    {"ph": "M", "name": "process_name", "pid": 1, "args": {"name": "/device:TFRT_CPU_0"}},
+    {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1, "args": {"name": "XLA Ops"}},
+    {"ph": "M", "name": "process_name", "pid": 2, "args": {"name": "python"}},
+    {"ph": "M", "name": "thread_name", "pid": 2, "tid": 1, "args": {"name": "main"}},
+    # device lane: fusion.1 runs twice (400 µs total), copy.2 once (200 µs)
+    {"ph": "X", "name": "fusion.1", "pid": 1, "tid": 1, "ts": 1000, "dur": 300,
+     "args": {"hlo_op": "fusion.1", "hlo_module": "jit_train_step"}},
+    {"ph": "X", "name": "copy.2", "pid": 1, "tid": 1, "ts": 1400, "dur": 200,
+     "args": {"hlo_op": "copy.2", "hlo_module": "jit_train_step"}},
+    {"ph": "X", "name": "fusion.1", "pid": 1, "tid": 1, "ts": 2000, "dur": 100,
+     "args": {"hlo_op": "fusion.1", "hlo_module": "jit_train_step"}},
+    # host lane: the scopes ops attribute to (innermost containing interval)
+    {"ph": "X", "name": "train", "pid": 2, "tid": 1, "ts": 900, "dur": 1500,
+     "args": {"step_num": 3}},
+    {"ph": "X", "name": "my_scope", "pid": 2, "tid": 1, "ts": 1300, "dur": 400},
+    # runtime noise: dispatch shims, python frames, C++ internals — never scopes
+    {"ph": "X", "name": "PjitFunction(train_step)", "pid": 2, "tid": 1, "ts": 950, "dur": 100},
+    {"ph": "X", "name": "$api.py:2733 block_until_ready", "pid": 2, "tid": 1, "ts": 1000, "dur": 50},
+    {"ph": "X", "name": "tsl::profiler::Collect", "pid": 2, "tid": 1, "ts": 1100, "dur": 10},
+    {},  # the trailing sentinel jax writes
+]
+
+
+def _write_golden_capture(base: Path) -> Path:
+    """The fixture in the real on-disk layout: <capture>/plugins/profile/
+    <stamp>/<host>.trace.json.gz."""
+    trace = base / "plugins" / "profile" / "2026_08_07" / "host.trace.json.gz"
+    trace.parent.mkdir(parents=True)
+    with gzip.open(trace, "wt") as fh:
+        json.dump({"traceEvents": _GOLDEN_EVENTS}, fh)
+    return trace
+
+
+def test_parse_trace_file_splits_ops_scopes_and_noise(tmp_path):
+    trace = _write_golden_capture(tmp_path)
+    parsed = parse_trace_file(trace)
+    assert parsed["processes"] == {1: "/device:TFRT_CPU_0", 2: "python"}
+    assert [op["name"] for op in parsed["ops"]] == ["fusion.1", "copy.2", "fusion.1"]
+    assert all(op["hlo_module"] == "jit_train_step" for op in parsed["ops"])
+    # noise names filtered; step_num carried through
+    assert [s["name"] for s in parsed["scopes"]] == ["train", "my_scope"]
+    assert parsed["scopes"][0]["step_num"] == 3
+    assert parsed["t_min_us"] == 900.0 and parsed["t_max_us"] == 2400.0
+
+
+def test_summarize_capture_pins_golden_table_exactly(tmp_path):
+    """The acceptance fixture: every derived number pinned. fusion.1's
+    midpoints (1150, 2050) fall only inside `train`; copy.2's midpoint
+    (1500) falls inside both scopes and must attribute to the innermost
+    (`my_scope`). Busy = 300+200+100 = 600 µs over a 1500 µs window."""
+    _write_golden_capture(tmp_path)
+    rep = summarize_capture(tmp_path)
+    assert rep["files"] == 1
+    assert rep["op_kinds"] == 2
+    assert rep["device_busy_us"] == 600.0
+    assert rep["device_idle_frac"] == 0.6
+    assert rep["steps"] == [3]
+    assert rep["ops"] == [
+        {"op": "fusion.1", "hlo_module": "jit_train_step", "count": 2,
+         "total_us": 400.0, "frac": 0.6667, "scope": "train"},
+        {"op": "copy.2", "hlo_module": "jit_train_step", "count": 1,
+         "total_us": 200.0, "frac": 0.3333, "scope": "my_scope"},
+    ]
+    assert rep["scopes"] == {
+        "train": {"device_us": 400.0, "frac": 0.6667},
+        "my_scope": {"device_us": 200.0, "frac": 0.3333},
+    }
+    (window,) = rep["windows"]
+    assert window["host"] == "/device:TFRT_CPU_0"
+    assert window["device_lanes"] == 1
+    assert window["window_us"] == 1500.0
+    assert window["device_busy_us"] == 600.0
+    assert window["device_idle_frac"] == 0.6
+    # top_k truncates the table but not the totals
+    assert [r["op"] for r in summarize_capture(tmp_path, top_k=1)["ops"]] == ["fusion.1"]
+
+
+def test_summarize_capture_rejects_empty_and_garbage(tmp_path):
+    with pytest.raises(CaptureError):
+        summarize_capture(tmp_path / "nowhere")
+    bad = tmp_path / "x.trace.json"
+    bad.write_text("not json")
+    with pytest.raises(CaptureError):
+        summarize_capture(bad)
+    assert find_trace_files(tmp_path / "nowhere") == []
+
+
+def test_prof_cli_renders_golden_capture(tmp_path, capsys):
+    _write_golden_capture(tmp_path)
+    assert prof_main([f"capture={tmp_path}"]) == 0
+    out = capsys.readouterr().out
+    assert "fusion.1" in out and "jit_train_step" in out
+    assert "my_scope" in out and "device share by scope" in out
+    assert "idle 60.0%" in out
+    # JSON mode round-trips the report
+    assert prof_main([f"capture={tmp_path}", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["captures"][0]["ops"][0]["op"] == "fusion.1"
+
+
+def test_prof_argv_contract():
+    run_dir, opts = parse_prof_argv(["run_dir=logs/x", "top_k=3", "--json"])
+    assert run_dir == "logs/x" and opts["top_k"] == 3 and opts["json"] is True
+    assert parse_prof_argv(["capture=/tmp/cap"])[1]["capture"] == "/tmp/cap"
+    assert parse_prof_argv(["logs/x"])[0] == "logs/x"  # bare positional run dir
+    with pytest.raises(ValueError):
+        parse_prof_argv([])  # needs run_dir= and/or capture=
+    with pytest.raises(ValueError):
+        parse_prof_argv(["bogus_flag=1"])
+
+
+def test_prof_report_folds_run_rooflines_and_captures(tmp_path):
+    """run_dir mode: captures are discovered via the stream's `trace`
+    events and the roofline verdicts per fn fold into the same report
+    (last emit wins — it carries the measured rate)."""
+    cap = tmp_path / "cap"
+    _write_golden_capture(cap)
+    run = tmp_path / "run"
+    run.mkdir()
+    events = [
+        {"event": "startup", "platform": "cpu", "device_kind": "cpu", "devices": 1, "rank": 0},
+        {"event": "trace", "step": 8, "action": "start", "trace_dir": str(cap)},
+        {"event": "roofline", "fn": "train_step", "flops": 1e9, "bytes_accessed": 1e9,
+         "intensity": 1.0, "bound": "memory", "ridge_intensity": 34.5},
+        {"event": "roofline", "fn": "train_step", "flops": 1e9, "bytes_accessed": 1e9,
+         "intensity": 1.0, "bound": "memory", "ridge_intensity": 34.5,
+         "calls_per_s": 12.0, "attained_frac": 0.25},
+        {"event": "shutdown", "step": 64},
+    ]
+    with open(run / "telemetry.jsonl", "w") as fh:
+        for rec in events:
+            fh.write(json.dumps(rec) + "\n")
+    report = prof_report(run_dir=run)
+    assert [c["capture_dir"] for c in report["captures"]] == [str(cap)]
+    (roof,) = report["rooflines"]
+    assert roof["attained_frac"] == 0.25  # the later, rate-refined emit won
+    text = render_text(report)
+    assert "roofline verdicts" in text and "memory-bound" in text
+    assert "attained 25.0% of roof" in text
+
+
+def test_prof_over_real_cpu_capture(tmp_path, capsys):
+    """THE acceptance path: profile a real jitted fn on the CPU backend,
+    then `sheeprl_tpu prof capture=<dir>` must print a non-empty per-op
+    device-time table with scope attribution."""
+    import jax
+    import jax.numpy as jnp
+
+    capdir = tmp_path / "xprof"
+    f = jax.jit(lambda a: ((a @ a) ** 2).sum())
+    x = jnp.ones((128, 128), jnp.float32)
+    jax.block_until_ready(f(x))  # compile outside the capture window
+    jax.profiler.start_trace(str(capdir))
+    try:
+        with jax.profiler.TraceAnnotation("hot_loop"):
+            for _ in range(4):
+                jax.block_until_ready(f(x))
+    finally:
+        jax.profiler.stop_trace()
+    if not find_trace_files(capdir):
+        pytest.skip("jax profiler wrote no trace files on this backend")
+    rep = summarize_capture(capdir)
+    assert rep["ops"], "real capture parsed to an empty op table"
+    assert rep["device_busy_us"] > 0
+    assert rep["scopes"], "no per-scope device attribution in the real capture"
+    assert prof_main([f"capture={capdir}"]) == 0
+    out = capsys.readouterr().out
+    assert "op(s) by device time" in out and "device share by scope" in out
+
+
+# -- MemorySampler -----------------------------------------------------------
+def test_host_rss_always_reports(monkeypatch):
+    assert host_rss_bytes() > 0
+    assert host_rss_peak_bytes() >= host_rss_bytes() // 2
+    # CPU-only/no-proc fallback: /proc gone → getrusage still reports
+    monkeypatch.setattr(mem_mod, "_proc_status_kib", lambda field: None)
+    assert host_rss_bytes() > 0
+
+
+def test_memory_snapshot_has_required_host_fields():
+    snap = memory_snapshot(census=True)
+    assert snap["rss_bytes"] > 0
+    assert snap.get("rss_peak_bytes", snap["rss_bytes"]) >= snap["rss_bytes"] // 2
+    # census fields appear when asked for (jax present in the test env)
+    assert "live_buffers" in snap
+
+
+def test_memory_sampler_emits_schema_valid_mem_events():
+    out = []
+    sampler = MemorySampler(out.append, role="worker", index=3, census_every=1,
+                            step_fn=lambda: 42)
+    rec = sampler.sample_once()
+    assert rec is out[0]
+    assert validate_event(rec) == []
+    assert rec["event"] == "mem" and rec["role"] == "worker"
+    assert rec["rss_bytes"] > 0
+    assert rec["worker"] == 3 and rec["index"] == 3  # role-named slot field
+    assert rec["step"] == 42
+    assert "live_buffers" in rec  # census_every=1 → census on every tick
+    assert sampler.rss_high_water >= rec["rss_bytes"]
+
+
+def test_memory_sampler_thread_cadence_and_final_sample():
+    out = []
+    sampler = MemorySampler(out.append, role="learner", interval_s=0.05,
+                            census_every=0).start()
+    time.sleep(0.35)
+    sampler.stop()  # joins the thread and emits the closing sample
+    assert len(out) >= 3
+    assert all(validate_event(rec) == [] for rec in out)
+    assert all(rec["role"] == "learner" for rec in out)
+    # stop() is idempotent and a torn sink never raises out of the sampler
+    sampler.stop()
+    boom = MemorySampler(lambda rec: 1 / 0, role="learner")
+    boom.sample_once()
+
+
+def test_memory_sampler_overhead_is_bounded():
+    """The cadenced sample must stay cheap enough to run every few seconds
+    on every process: 100 census-free samples well under a second each."""
+    sampler = MemorySampler(lambda rec: None, role="learner", census_every=0)
+    t0 = time.perf_counter()
+    for _ in range(100):
+        sampler.sample_once()
+    per_sample = (time.perf_counter() - t0) / 100
+    assert per_sample < 0.02, f"mem sample costs {per_sample * 1e3:.1f}ms"
+
+
+def test_start_sampler_respects_config_gate():
+    class Off:
+        def select(self, path, default=None):
+            return {"diag.mem.enabled": False}.get(path, default)
+
+    assert start_sampler(Off(), lambda rec: None, "worker") is None
+    sampler = start_sampler(None, lambda rec: None, "broker", index=1)
+    try:
+        assert sampler is not None and sampler.role == "broker"
+    finally:
+        sampler.stop(final_sample=False)
+
+
+# -- roofline math -----------------------------------------------------------
+def test_roofline_record_classifies_bounds():
+    # intensity 1 flop/B below the ridge (10) → memory-bound; the binding
+    # roof is bandwidth × intensity = 1e11 flop/s
+    rec = roofline_record(
+        "train_step", {"flops": 1e9, "bytes_accessed": 1e9},
+        peak_flops=1e12, peak_bytes_per_s=1e11, calls_per_s=10.0, role="learner",
+    )
+    assert validate_event(rec) == []
+    assert rec["bound"] == "memory" and rec["ridge_intensity"] == 10.0
+    assert rec["attained_flops_per_s"] == pytest.approx(1e10)
+    assert rec["attained_frac"] == pytest.approx(1e10 / 1e11)
+    # intensity 100 above the ridge → compute-bound, roof = peak_flops
+    rec = roofline_record(
+        "apply", {"flops": 1e11, "bytes_accessed": 1e9},
+        peak_flops=1e12, peak_bytes_per_s=1e11, calls_per_s=5.0,
+    )
+    assert rec["bound"] == "compute"
+    assert rec["attained_frac"] == pytest.approx(5e11 / 1e12)
+    # missing either cost axis → no verdict; missing peaks → unknown bound
+    assert roofline_record("f", {"flops": 1e9}) is None
+    assert roofline_record("f", {}) is None
+    assert roofline_record("f", {"flops": 1.0, "bytes_accessed": 1.0})["bound"] == "unknown"
+
+
+# -- live aggregation + rendering -------------------------------------------
+def test_aggregator_memory_rollup_and_top_render():
+    agg = LiveAggregator()
+    agg.ingest({"event": "mem", "role": "learner", "rss_bytes": 1 << 30,
+                "rss_peak_bytes": 2 << 30, "hbm_bytes_in_use": 3 << 30,
+                "hbm_bytes_limit": 16 << 30, "t": time.time()})
+    agg.ingest({"event": "mem", "role": "worker", "index": 0, "worker": 0,
+                "rss_bytes": 512 << 20, "t": time.time()}, stream="worker_000")
+    # a later, lower learner sample: stream row updates, high-water holds
+    agg.ingest({"event": "mem", "role": "learner", "rss_bytes": 900 << 20,
+                "hbm_bytes_in_use": 1 << 30, "t": time.time()})
+    snap = agg.snapshot()
+    mem = snap["memory"]
+    assert set(mem["streams"]) == {"learner", "worker_000"}
+    assert mem["streams"]["learner"]["rss_bytes"] == 900 << 20
+    assert mem["streams"]["worker_000"]["rss_bytes"] == 512 << 20
+    assert mem["high_water"]["learner"]["rss_bytes"] == 2 << 30
+    assert mem["high_water"]["learner"]["hbm_bytes"] == 3 << 30
+    assert mem["high_water"]["worker"]["rss_bytes"] == 512 << 20
+
+    from sheeprl_tpu.diag.live import render_snapshot
+
+    text = render_snapshot(snap)
+    assert "rss MiB" in text and "hbm MiB" in text
+    assert "worker_000" in text
+    assert "high-water:" in text and "learner rss=2048MiB hbm=3072MiB" in text
+
+
+def test_prometheus_memory_roofline_and_cache_families():
+    reg = Registry()
+    reg.observe_event({"event": "mem", "role": "learner", "rss_bytes": 1048576,
+                       "hbm_bytes_in_use": 2097152, "hbm_peak_bytes": 4194304,
+                       "live_buffer_bytes": 512})
+    reg.observe_event({"event": "roofline", "fn": "train_step", "flops": 1e9,
+                       "bytes_accessed": 1e9, "intensity": 1.0, "bound": "memory",
+                       "attained_frac": 0.25})
+    # cache counters are run-cumulative in the JSONL → monotonic *_total here
+    reg.observe_event({"event": "log", "step": 32, "xla": {"cache_hits": 3, "cache_misses": 1}})
+    reg.observe_event({"event": "log", "step": 64, "xla": {"cache_hits": 7, "cache_misses": 1}})
+    text = reg.render()
+    assert 'sheeprl_host_rss_bytes{role="learner"} 1048576' in text
+    assert 'sheeprl_hbm_bytes_in_use{role="learner"} 2097152' in text
+    assert 'sheeprl_hbm_peak_bytes{role="learner"} 4194304' in text
+    assert 'sheeprl_live_buffer_bytes{role="learner"} 512' in text
+    assert 'sheeprl_roofline_attained_frac{fn="train_step"} 0.25' in text
+    assert 'sheeprl_roofline_intensity{fn="train_step"} 1' in text
+    assert "sheeprl_compile_cache_hits_total 7" in text
+    assert "sheeprl_compile_cache_misses_total 1" in text
+
+
+# -- doctor red/green --------------------------------------------------------
+def _mem_run(run_dir: Path, events) -> Path:
+    base = [{"event": "startup", "platform": "cpu", "device_kind": "cpu",
+             "devices": 1, "rank": 0, "algo": "ppo"}]
+    run_dir.mkdir(parents=True, exist_ok=True)
+    with open(run_dir / "telemetry.jsonl", "w") as fh:
+        for rec in base + list(events) + [{"event": "shutdown", "step": 512}]:
+            fh.write(json.dumps(rec) + "\n")
+    return run_dir
+
+
+def _mem_series(role, rss_fn, n=11, t0=1000.0, dt=30.0, **extra):
+    out = []
+    for i in range(n):
+        rec = {"event": "mem", "role": role, "rss_bytes": int(rss_fn(i)),
+               "t": t0 + i * dt, "step": i * 32}
+        rec.update(extra)
+        out.append(rec)
+    return out
+
+
+def test_doctor_hbm_pressure_red_green(tmp_path):
+    lim = 16 << 30
+    red = _mem_run(tmp_path / "red", _mem_series(
+        "learner", lambda i: 1 << 30, hbm_bytes_limit=lim, hbm_peak_bytes=int(0.95 * lim)))
+    finding = next(f for f in diagnose(red)["findings"] if f["code"] == "hbm_pressure")
+    assert finding["severity"] == "warning"
+    assert finding["data"]["hbm_bytes_limit"] == lim
+    assert finding["data"]["frac"] == pytest.approx(0.95)
+    assert "donate" in finding["remediation"]
+    # green: half the limit → headroom, no finding
+    green = _mem_run(tmp_path / "green", _mem_series(
+        "learner", lambda i: 1 << 30, hbm_bytes_limit=lim, hbm_peak_bytes=lim // 2))
+    assert not [f for f in diagnose(green)["findings"] if f["code"] == "hbm_pressure"]
+
+
+def test_doctor_host_mem_leak_red_green(tmp_path):
+    base = 1 << 30
+    # red: the learner grows +32 MiB every 30 s sample (11 samples, 300 s
+    # span, +320 MiB, monotonic); a flat worker rides along and must NOT fire
+    red_events = _mem_series("learner", lambda i: base + i * (32 << 20)) + _mem_series(
+        "worker", lambda i: base, worker=0, index=0)
+    findings = diagnose(_mem_run(tmp_path / "red", red_events))["findings"]
+    leaks = [f for f in findings if f["code"] == "host_mem_leak"]
+    assert len(leaks) == 1 and leaks[0]["data"]["role"] == "learner"
+    assert leaks[0]["data"]["growth_bytes"] == 320 << 20
+    assert leaks[0]["data"]["samples"] == 11
+    assert leaks[0]["data"]["rate_mb_per_h"] == pytest.approx(320 / (300 / 3600), rel=1e-3)
+    # green: a GC sawtooth with the same net growth rises in only half the
+    # intervals → the rise-fraction guard keeps it quiet
+    saw = _mem_series("learner", lambda i: base + i * (32 << 20) * (1 if i % 2 else -1))
+    assert not [f for f in diagnose(_mem_run(tmp_path / "saw", saw))["findings"]
+                if f["code"] == "host_mem_leak"]
+    # green: short/flat series never fires
+    flat = _mem_series("learner", lambda i: base)
+    assert not [f for f in diagnose(_mem_run(tmp_path / "flat", flat))["findings"]
+                if f["code"] == "host_mem_leak"]
+
+
+def test_doctor_memory_bound_red_green(tmp_path):
+    roof = {"event": "roofline", "fn": "train_step", "flops": 1e9,
+            "bytes_accessed": 2e9, "intensity": 0.5, "bound": "memory",
+            "ridge_intensity": 34.5, "attained_frac": 0.21, "step": 64}
+    finding = next(f for f in diagnose(_mem_run(tmp_path / "red", [roof]))["findings"]
+                   if f["code"] == "memory_bound")
+    assert finding["severity"] == "info"
+    assert "train_step" in finding["title"]
+    assert finding["data"]["train_step"]["intensity"] == 0.5
+    assert "attaining 21%" in finding["detail"]
+    # green: compute-bound verdicts stay out of the findings list
+    compute = dict(roof, bound="compute", intensity=100.0)
+    assert not [f for f in diagnose(_mem_run(tmp_path / "green", [compute]))["findings"]
+                if f["code"] == "memory_bound"]
+
+
+def test_timeline_memory_helpers(tmp_path):
+    lim = 16 << 30
+    events = (
+        _mem_series("learner", lambda i: (1 << 30) + i, n=3,
+                    hbm_bytes_limit=lim, hbm_bytes_in_use=2 << 30)
+        + _mem_series("worker", lambda i: 1 << 29, n=2, worker=0, index=0)
+        + [{"event": "roofline", "fn": "train_step", "flops": 1.0,
+            "bytes_accessed": 2.0, "intensity": 0.5, "bound": "memory"}]
+    )
+    run = _mem_run(tmp_path / "run", events)
+    tl = Timeline.from_path(run / "telemetry.jsonl")
+    assert tl.mem_roles() == ["learner", "worker"]
+    assert len(tl.rss_series("learner")) == 3
+    assert len(tl.rss_series()) == 5  # role=None keeps every sampler's points
+    assert tl.hbm_high_water() == (2 << 30, lim)
+    assert tl.rooflines()["train_step"]["bound"] == "memory"

@@ -395,3 +395,14 @@ def test_ppo_smoke_emits_valid_jsonl(monkeypatch):
     shutdown = by_type["shutdown"][0]
     assert shutdown["step"] >= 32
     assert shutdown["total_grad_steps"] > 0
+
+    # the learner's MemorySampler grows a host-RSS watermark series even on
+    # the CPU backend (the closing sample is emitted on facade close)
+    mems = by_type.get("mem", [])
+    assert mems, "learner MemorySampler emitted no mem events"
+    assert all(rec["role"] == "learner" and rec["rss_bytes"] > 0 for rec in mems)
+    # the update fn registers its lowered cost → one roofline verdict
+    rooflines = [rec for rec in by_type.get("roofline", []) if rec["fn"] == "train_step"]
+    assert rooflines, "train loop did not register the update's roofline"
+    assert rooflines[0]["intensity"] > 0
+    assert rooflines[0]["bound"] in ("compute", "memory", "unknown")
